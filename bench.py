@@ -1,0 +1,73 @@
+"""Benchmark entry point — prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Current flagship benchmark: AlexNet (reference alexnet.cc topology) training
+throughput on the local TPU chip(s), synthetic data (reference parity:
+cnn.cc:110-128 timed loop printing images/s).  The reference publishes no
+absolute numbers (BASELINE.md), so vs_baseline is the speedup of the benched
+strategy over our own pure-data-parallel run on identical hardware — the
+reference's headline metric (strategy vs DP).  Pass a strategy file as argv[1]
+to bench it; with no strategy the benched config IS pure DP, so
+vs_baseline = 1.0 by definition (no second run is made).
+"""
+
+import json
+import sys
+import time
+
+
+def run(batch_size=64, iters=12, warmup=4, dtype="bfloat16",
+        strategy_file=None):
+    import jax
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.data import synthetic_batches
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    machine = MachineModel()
+    cfg = FFConfig(batch_size=batch_size, input_height=224, input_width=224,
+                   num_iterations=iters, print_freq=0, compute_dtype=dtype,
+                   strategy_file=strategy_file or "")
+    ff = build_alexnet(cfg, machine)
+    params, state = ff.init()
+    opt_state = ff.init_opt_state(params)
+    step = ff.make_train_step()
+    data = synthetic_batches(machine, batch_size, 224, 224, mode="ones")
+
+    batches = [next(data) for _ in range(2)]
+    for i in range(warmup):
+        img, lbl = batches[i % 2]
+        params, state, opt_state, loss = step(params, state, opt_state,
+                                              img, lbl)
+    float(loss)  # full sync (the steps form one dependency chain)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        img, lbl = batches[i % 2]
+        params, state, opt_state, loss = step(params, state, opt_state,
+                                              img, lbl)
+    float(loss)
+    elapsed = time.perf_counter() - t0
+    tput = iters * batch_size / elapsed
+    per_chip = tput / machine.num_devices
+    return per_chip, tput, elapsed
+
+
+def main():
+    strategy_file = sys.argv[1] if len(sys.argv) > 1 else None
+    per_chip, tput, elapsed = run(strategy_file=strategy_file)
+    if strategy_file:
+        dp_per_chip, _, _ = run(strategy_file=None)
+        vs_baseline = round(per_chip / dp_per_chip, 4)
+    else:
+        vs_baseline = 1.0  # benched config is itself the pure-DP baseline
+    print(json.dumps({
+        "metric": "alexnet_train_throughput_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/s/chip",
+        "vs_baseline": vs_baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

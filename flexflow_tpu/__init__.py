@@ -1,0 +1,39 @@
+"""flexflow-tpu: a TPU-native deep-learning framework with per-layer
+("layer-wise") auto-parallelism, re-designed from scratch for JAX/XLA.
+
+Capability model (see SURVEY.md): every operator independently chooses a
+partition grid over its tensor dimensions (sample / channel / height / width,
+or batch / vocab / sequence for RNNs) plus an explicit device assignment — the
+per-op "strategy" — and an execution simulator with MCMC search finds hybrid
+strategies that beat pure data parallelism.
+
+TPU-native architecture:
+  * a strategy entry (``ParallelConfig``) compiles to a ``jax.sharding.Mesh``
+    over its device list plus a ``NamedSharding`` — XLA/GSPMD derives all
+    communication (the role Legion region deps + GASNet play in the
+    reference, /root/reference/strategy.proto, conv_2d.cu:61-208);
+  * operator kernels are XLA HLO (MXU matmuls/convs in bf16-friendly form)
+    instead of cuDNN/cuBLAS leaf tasks;
+  * gradient aggregation across replicas is XLA all-reduce over ICI instead of
+    the reference's serial ``updateGAS`` (cuda_helper.cu:57-71);
+  * the strategy searcher (flexflow_tpu.sim, in progress) is a task-graph
+    simulator + Metropolis MCMC, cost-calibrated for MXU FLOPs and ICI/DCN
+    bandwidth (reference: scripts/simulator.cc).
+"""
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.model import FFModel, Tensor
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FFConfig",
+    "ParallelConfig",
+    "Strategy",
+    "MachineModel",
+    "FFModel",
+    "Tensor",
+    "__version__",
+]

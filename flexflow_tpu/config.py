@@ -1,0 +1,102 @@
+"""Training/run configuration, equivalent of the reference's ``FFConfig``
+(config.h:41-56) with CLI parity with ``parse_input_args`` (cnn.cc:539-582)
+and ``DefaultConfig`` (cnn.cc:23-35)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from flexflow_tpu.strategy import Strategy
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # DefaultConfig parity (cnn.cc:23-35)
+    epochs: int = 10
+    batch_size: int = 64
+    num_iterations: int = 10
+    print_freq: int = 10
+    input_height: int = 224
+    input_width: int = 224
+    learning_rate: float = 0.01
+    weight_decay: float = 1e-4
+    momentum: float = 0.0
+    num_nodes: int = 1
+    workers_per_node: int = 0      # -ll:gpu analog; 0 = use all local chips
+    loaders_per_node: int = 4      # -ll:cpu analog (data-loader threads)
+    profiling: bool = False
+    synthetic_input: bool = True   # reference default when -d absent (README.md:68)
+    dataset_path: str = ""
+    strategy_file: str = ""
+    # TPU-native additions
+    compute_dtype: str = "float32"   # "bfloat16" for MXU-friendly training
+    param_dtype: str = "float32"
+    seed: int = 0
+    num_classes: int = 1000
+
+    strategies: Strategy = dataclasses.field(default_factory=Strategy)
+
+    def __post_init__(self):
+        if self.strategy_file:
+            self.load_strategy_file(self.strategy_file)
+
+    # FFConfig::load/save_strategy_file parity (strategy.cc:62-86)
+    def load_strategy_file(self, filename: str) -> bool:
+        self.strategies = Strategy.load(filename)
+        return True
+
+    def save_strategy_file(self, filename: str) -> bool:
+        self.strategies.save(filename)
+        return True
+
+    @classmethod
+    def from_args(cls, argv: Sequence[str]) -> "FFConfig":
+        """Parse the reference's flag set (cnn.cc:539-582): -e/--epochs,
+        -b/--batch-size, --lr, --wd, -p/--print-freq, -d/--dataset,
+        -s/--strategy, plus TPU-native extras (--dtype, --iters, --seed,
+        --profiling)."""
+        cfg = cls()
+        args = list(argv)
+        i = 0
+        while i < len(args):
+            a = args[i]
+
+            def val() -> str:
+                nonlocal i
+                i += 1
+                if i >= len(args):
+                    raise ValueError(f"flag {a!r} expects a value")
+                return args[i]
+
+            if a in ("-e", "--epochs"):
+                cfg.epochs = int(val())
+            elif a in ("-b", "--batch-size"):
+                cfg.batch_size = int(val())
+            elif a in ("--lr", "--learning-rate"):
+                cfg.learning_rate = float(val())
+            elif a in ("--wd", "--weight-decay"):
+                cfg.weight_decay = float(val())
+            elif a in ("-p", "--print-freq"):
+                cfg.print_freq = int(val())
+            elif a in ("-d", "--dataset"):
+                cfg.dataset_path = val()
+                cfg.synthetic_input = False
+            elif a in ("-s", "--strategy"):
+                cfg.strategy_file = val()
+                cfg.load_strategy_file(cfg.strategy_file)
+            elif a == "-ll:gpu":   # accepted for drop-in compatibility
+                cfg.workers_per_node = int(val())
+            elif a == "-ll:cpu":
+                cfg.loaders_per_node = int(val())
+            elif a in ("-i", "--iters", "--iterations"):
+                cfg.num_iterations = int(val())
+            elif a == "--dtype":
+                cfg.compute_dtype = val()
+            elif a == "--seed":
+                cfg.seed = int(val())
+            elif a == "--profiling":
+                cfg.profiling = True
+            # unknown flags are ignored, like the reference parser
+            i += 1
+        return cfg

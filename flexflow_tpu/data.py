@@ -1,0 +1,47 @@
+"""Data input: synthetic generator (reference parity: init_images_task /
+init_labels_task fill images=1.0, labels=1 when no dataset is given,
+model.cu:213-257) plus a deterministic random mode for tests, with batches
+placed data-parallel across the machine's devices."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.machine import MachineModel
+
+
+def _batch_sharding(machine: MachineModel):
+    from jax.sharding import PartitionSpec as P
+
+    from flexflow_tpu.strategy import ParallelConfig
+
+    n = machine.num_devices
+    pc = ParallelConfig((n,), tuple(range(n)))
+    return machine.sharding(pc, ("n",), P("n"))
+
+
+def synthetic_batches(machine: MachineModel, batch_size: int, height: int,
+                      width: int, channels: int = 3, num_classes: int = 1000,
+                      mode: str = "ones", seed: int = 0,
+                      dtype: str = "float32") -> Iterator[Tuple]:
+    """Yield (image NHWC, labels) forever.
+
+    mode="ones": image=1.0, label=1 — exact parity with model.cu:213-257.
+    mode="random": fixed-seed Gaussian images / uniform labels, for tests
+    where constant inputs would hide bugs.
+    """
+    import jax
+
+    img_sh = _batch_sharding(machine)
+    lbl_sh = img_sh
+    rng = np.random.RandomState(seed)
+    while True:
+        if mode == "ones":
+            img = np.ones((batch_size, height, width, channels), dtype)
+            lbl = np.ones((batch_size,), np.int32)
+        else:
+            img = rng.randn(batch_size, height, width, channels).astype(dtype)
+            lbl = rng.randint(0, num_classes, size=(batch_size,)).astype(np.int32)
+        yield (jax.device_put(img, img_sh), jax.device_put(lbl, lbl_sh))

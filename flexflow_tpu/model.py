@@ -1,0 +1,263 @@
+"""FFModel: the layer DAG + training loop, equivalent of the reference's
+FFModel (model.h:121-171, model.cc, model.cu) re-designed for XLA.
+
+Reference behavior mapped here:
+
+  * builder methods conv2d/pool2d/batch_norm/linear/concat/flat/softmax
+    (model.h:126-153) build a named-op DAG; each op looks up its
+    ParallelConfig in ``config.strategies`` and falls back to pure data
+    parallelism (cnn.cc:76-86);
+  * forward()/backward()/update() (model.cu:300-316) become ONE jitted
+    ``train_step``: XLA sees the whole iteration — forward, jax.grad
+    backward, SGD update — and schedules/fuses it globally, which is the
+    TPU-native analog of Legion's asynchronous task graph for an iteration
+    (SURVEY.md §3.1 "the hot loop");
+  * per-op partitioning is applied as ``with_sharding_constraint`` on each
+    op's output (and on its params at init), so GSPMD derives all
+    repartitioning between differently-gridded producers/consumers — the
+    role of Legion's implicit copies (conv_2d.cu:171-208);
+  * ``update()``'s replica aggregation (updateGAS, cuda_helper.cu:57-71) is
+    implicit: gradients of replicated params arrive all-reduced by GSPMD.
+
+SGD semantics: ``v = mu*v + g + wd*p;  p -= lr*v`` with the loss averaged
+over the *global* batch (see ops/softmax.py for why this normalization).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.ops import (Concat, Conv2D, Flat, Linear, Op, Pool2D,
+                              Softmax, Tensor)
+from flexflow_tpu.ops.norm import BatchNorm
+from flexflow_tpu.ops.pool import POOL_MAX
+from flexflow_tpu.strategy import ParallelConfig, validate_strategy
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None,
+                 machine: Optional[MachineModel] = None):
+        self.config = config or FFConfig()
+        self.machine = machine or MachineModel()
+        validate_strategy(self.config.strategies, self.machine.num_devices)
+        self.layers: List[Op] = []
+        self._inputs: List[Tensor] = []
+        self._train_step = None
+        self._eval_step = None
+
+    # ------------------------------------------------------------------
+    # graph building (model.h:126-153 API parity)
+
+    def _pc(self, name: str, ndims: int) -> ParallelConfig:
+        pc = self.config.strategies.get(name)
+        if pc is None:
+            pc = self.machine.default_pc(ndims)
+        return pc
+
+    def _add(self, op: Op) -> Tensor:
+        self.layers.append(op)
+        return op.output
+
+    def create_input(self, shape, dtype: str = "float32",
+                     name: str = "input") -> Tensor:
+        t = Tensor(shape, dtype, None, name)
+        self._inputs.append(t)
+        return t
+
+    def conv2d(self, name, input, out_channels, kernel_h, kernel_w,
+               stride_h, stride_w, padding_h, padding_w,
+               relu: bool = False) -> Tensor:
+        return self._add(Conv2D(name, self._pc(name, 4), input, out_channels,
+                                kernel_h, kernel_w, stride_h, stride_w,
+                                padding_h, padding_w, relu))
+
+    def pool2d(self, name, input, kernel_h, kernel_w, stride_h, stride_w,
+               padding_h, padding_w, pool_type: str = POOL_MAX,
+               relu: bool = True) -> Tensor:
+        return self._add(Pool2D(name, self._pc(name, 4), input, kernel_h,
+                                kernel_w, stride_h, stride_w, padding_h,
+                                padding_w, pool_type, relu))
+
+    def batch_norm(self, name, input, relu: bool = True) -> Tensor:
+        return self._add(BatchNorm(name, self._pc(name, 4), input, relu))
+
+    def linear(self, name, input, out_channels, relu: bool = True) -> Tensor:
+        return self._add(Linear(name, self._pc(name, 2), input, out_channels,
+                                relu))
+
+    def concat(self, name, tensors: List[Tensor]) -> Tensor:
+        return self._add(Concat(name, self._pc(name, 4), tensors))
+
+    def flat(self, name, input) -> Tensor:
+        return self._add(Flat(name, self._pc(name, 2), input))
+
+    def softmax(self, name, input) -> Tensor:
+        return self._add(Softmax(name, self._pc(name, 1), input))
+
+    # ------------------------------------------------------------------
+    # parameters
+
+    def init(self, seed: Optional[int] = None):
+        """Initialize (params, state), placing each param with its op's
+        sharding (reference: INIT_PARA tasks writing into replicated
+        regions, conv_2d.cu:374-419)."""
+        import jax
+
+        seed = self.config.seed if seed is None else seed
+        key = jax.random.PRNGKey(seed)
+        params: Dict[str, Dict] = {}
+        state: Dict[str, Dict] = {}
+        for op in self.layers:
+            key, sub = jax.random.split(key)
+            p = op.init_params(sub)
+            if p:
+                shardings = op.param_shardings(self.machine)
+                params[op.name] = {
+                    k: jax.device_put(v, shardings[k]) for k, v in p.items()
+                }
+            s = op.init_state()
+            if s:
+                state[op.name] = s
+        return params, state
+
+    def init_opt_state(self, params):
+        import jax
+
+        return jax.tree.map(lambda p: p * 0.0, params)
+
+    # ------------------------------------------------------------------
+    # execution
+
+    def _loss_op(self) -> Softmax:
+        for op in reversed(self.layers):
+            if getattr(op, "is_loss", False):
+                return op
+        raise ValueError("model has no loss (softmax) layer")
+
+    def apply(self, params, state, inputs: Dict[int, Any], train: bool):
+        """Run the DAG. ``inputs`` maps input-Tensor tid -> array.
+        Returns (tensor-values dict, new_state)."""
+        from jax import lax
+
+        multi = self.machine.num_devices > 1
+        values: Dict[int, Any] = dict(inputs)
+        new_state: Dict[str, Dict] = {}
+        for op in self.layers:
+            xs = [values[t.tid] for t in op.inputs]
+            y, st = op.forward(params.get(op.name, {}),
+                               state.get(op.name, {}), xs, train)
+            if multi:
+                y = lax.with_sharding_constraint(
+                    y, op.output_sharding(self.machine))
+            values[op.output.tid] = y
+            if st:
+                new_state[op.name] = st
+        return values, new_state
+
+    def loss_fn(self, params, state, image, labels, train: bool = True):
+        loss_op = self._loss_op()
+        inputs = {self._inputs[0].tid: image}
+        values, new_state = self.apply(params, state, inputs, train)
+        loss = loss_op.loss(values[loss_op.output.tid], labels)
+        return loss, new_state
+
+    def make_train_step(self):
+        """Jitted full training iteration (forward+backward+update)."""
+        import jax
+
+        cfg = self.config
+        lr, wd, mu = cfg.learning_rate, cfg.weight_decay, cfg.momentum
+        cdtype = cfg.compute_dtype
+
+        def train_step(params, state, opt_state, image, labels):
+            image = image.astype(cdtype)
+
+            def lf(p):
+                return self.loss_fn(p, state, image, labels, train=True)
+
+            (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(
+                params)
+
+            def upd(p, g, v):
+                v = mu * v + g + wd * p
+                return p - lr * v, v
+
+            new_params_and_v = jax.tree.map(upd, params, grads, opt_state)
+            new_params = jax.tree.map(lambda t: t[0], new_params_and_v,
+                                      is_leaf=lambda t: isinstance(t, tuple))
+            new_v = jax.tree.map(lambda t: t[1], new_params_and_v,
+                                 is_leaf=lambda t: isinstance(t, tuple))
+            return new_params, new_state, new_v, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def make_eval_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        loss_op = self._loss_op()
+
+        def eval_step(params, state, image, labels):
+            image = image.astype(self.config.compute_dtype)
+            inputs = {self._inputs[0].tid: image}
+            values, _ = self.apply(params, state, inputs, train=False)
+            log_probs = values[loss_op.output.tid]
+            loss = loss_op.loss(log_probs, labels)
+            acc = jnp.mean((jnp.argmax(log_probs, axis=-1) == labels)
+                           .astype("float32"))
+            return loss, acc
+
+        return jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    # training loop (cnn.cc:110-128 parity: timed loop printing images/s)
+
+    def fit(self, data_iter, num_iterations: Optional[int] = None,
+            warmup: int = 1, log=print):
+        import jax
+
+        num_iterations = num_iterations or self.config.num_iterations
+        warmup = min(warmup, max(num_iterations - 1, 0))
+        params, state = self.init()
+        opt_state = self.init_opt_state(params)
+        step = self.make_train_step()
+
+        losses = []
+        start = time.perf_counter()
+        loss = None
+        for it in range(num_iterations):
+            image, labels = next(data_iter)
+            if it == warmup:
+                if loss is not None:
+                    float(loss)  # sync (block_until_ready is unreliable
+                                 # under the axon tunnel)
+                start = time.perf_counter()
+            params, state, opt_state, loss = step(params, state, opt_state,
+                                                  image, labels)
+            losses.append(loss)
+            if self.config.print_freq and (it + 1) % self.config.print_freq == 0:
+                log(f"iter {it + 1}: loss = {float(loss):.4f}")
+        if loss is not None:
+            float(loss)
+        elapsed = time.perf_counter() - start
+        n_timed = num_iterations - warmup
+        throughput = (n_timed * self.config.batch_size / elapsed
+                      if elapsed > 0 and n_timed > 0 else 0.0)
+        log(f"time = {elapsed:.4f}s, tp = {throughput:.2f} images/s")
+        return {
+            "params": params, "state": state,
+            "loss": [float(l) for l in losses],
+            "elapsed_s": elapsed, "images_per_sec": throughput,
+        }
+
+    def summary(self) -> str:
+        lines = [f"FFModel: {len(self.layers)} layers, "
+                 f"{self.machine.num_devices} devices"]
+        for op in self.layers:
+            lines.append(
+                f"  {op.name:<16s} {type(op).__name__:<10s} "
+                f"grid={op.pc.dims} out={op.output.shape}")
+        return "\n".join(lines)

@@ -1,0 +1,7 @@
+"""Model zoo (reference §2.8): AlexNet, VGG-16, Inception-v3, ResNet-101,
+DenseNet-121, NMT seq2seq — built through the FFModel layer API so every
+layer picks up its strategy entry."""
+
+from flexflow_tpu.models.alexnet import add_alexnet_layers, build_alexnet
+
+__all__ = ["add_alexnet_layers", "build_alexnet"]

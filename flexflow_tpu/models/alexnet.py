@@ -1,0 +1,36 @@
+"""AlexNet topology — exact layer parity with the reference's
+``FFModel::add_layers`` (alexnet.cc:3-18), including its quirks: convs
+without ReLU, pools with ReLU (the reference defaults), and the typo'd
+layer name "lienar1"."""
+
+from __future__ import annotations
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import FFModel, Tensor
+
+
+def add_alexnet_layers(ff: FFModel, image: Tensor) -> Tensor:
+    t = ff.conv2d("conv1", image, 64, 11, 11, 4, 4, 2, 2)
+    t = ff.pool2d("pool1", t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d("conv2", t, 192, 5, 5, 1, 1, 2, 2)
+    t = ff.pool2d("pool2", t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d("conv3", t, 384, 3, 3, 1, 1, 1, 1)
+    t = ff.conv2d("conv4", t, 256, 3, 3, 1, 1, 1, 1)
+    t = ff.conv2d("conv5", t, 256, 3, 3, 1, 1, 1, 1)
+    t = ff.pool2d("pool3", t, 3, 3, 2, 2, 0, 0)
+    t = ff.flat("flat", t)
+    t = ff.linear("lienar1", t, 4096)   # sic — alexnet.cc:13
+    t = ff.linear("linear2", t, 4096)
+    t = ff.linear("linear3", t, 1000, relu=False)
+    t = ff.softmax("softmax", t)
+    return t
+
+
+def build_alexnet(config: FFConfig = None, machine=None) -> FFModel:
+    ff = FFModel(config, machine)
+    cfg = ff.config
+    image = ff.create_input(
+        (cfg.batch_size, cfg.input_height, cfg.input_width, 3),
+        name="image")
+    add_alexnet_layers(ff, image)
+    return ff

@@ -1,0 +1,23 @@
+"""Operator library: TPU-native equivalents of the reference's per-op CUDA
+files (conv_2d.cu, pool_2d.cu, batch_norm.cu, linear.cu, flat.cu, softmax.cu,
+concat.cu, nmt/{embed,lstm,linear,softmax_data_parallel}.cu).
+
+Each op is a factory + pure-functional forward; partitioning is expressed as
+a GSPMD sharding derived from the op's ParallelConfig rather than Legion
+index partitions, and backward/update paths are derived by jax.grad + XLA
+collectives rather than hand-written leaf tasks.
+"""
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.ops.conv import Conv2D
+from flexflow_tpu.ops.pool import Pool2D
+from flexflow_tpu.ops.norm import BatchNorm
+from flexflow_tpu.ops.linear import Linear
+from flexflow_tpu.ops.flat import Flat
+from flexflow_tpu.ops.softmax import Softmax
+from flexflow_tpu.ops.concat import Concat
+
+__all__ = [
+    "Op", "Tensor", "Conv2D", "Pool2D", "BatchNorm", "Linear", "Flat",
+    "Softmax", "Concat",
+]

@@ -1,0 +1,124 @@
+"""Op / Tensor base abstractions.
+
+Reference equivalents: ``Tensor`` (model.h:85-89) and ``Op``
+(model.h:101-119).  Differences by design:
+
+  * a Tensor here is *symbolic* (shape/dtype/producer); concrete values flow
+    through the functional ``forward`` — there are no regions or partitions
+    to materialize, XLA/GSPMD owns physical layout;
+  * ``Op.forward`` is pure: ``(params, state, inputs) -> (output, state)``.
+    backward() and update() have no per-op code — they are jax.grad plus the
+    optimizer, with cross-replica reductions inserted by GSPMD (the role of
+    the reference's per-op backward tasks and ``updateGAS``,
+    cuda_helper.cu:57-71);
+  * activations use NHWC (TPU/MXU-preferred), while the strategy grid keeps
+    the reference's (w, h, c, n) dim order (conv_2d.cu:69-75) for
+    strategy-file compatibility — the mapping lives in ``output_spec``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.strategy import ParallelConfig
+
+_tensor_ids = itertools.count()
+
+
+class Tensor:
+    """Symbolic tensor: static shape + dtype + producing op (model.h:85-89
+    analog; ``adim`` -> shape, region/part -> sharding owned by the op)."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype: str = "float32",
+                 producer: Optional["Op"] = None, name: str = ""):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = dtype
+        self.producer = producer
+        self.name = name
+        self.tid = next(_tensor_ids)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def size(self) -> int:
+        return math.prod(self.shape)
+
+    def __repr__(self):
+        p = self.producer.name if self.producer else "input"
+        return f"Tensor(name={self.name!r}, shape={self.shape}, from={p})"
+
+
+class Op:
+    """Base operator: named, with inputs, one output, a ParallelConfig, and
+    a pure functional forward.  (model.h:101-119 analog.)"""
+
+    #: mesh axis names for this op's grid, innermost (grid dim 0) first;
+    #: subclasses override, e.g. ("w", "h", "c", "n") for 4-D CNN ops.
+    AXIS_NAMES: Tuple[str, ...] = ("n",)
+
+    def __init__(self, name: str, pc: ParallelConfig,
+                 inputs: Sequence[Tensor]):
+        if len(pc.dims) != len(self.AXIS_NAMES):
+            raise ValueError(
+                f"op {name!r}: ParallelConfig rank {pc.ndims} does not match "
+                f"op grid rank {len(self.AXIS_NAMES)} ({self.AXIS_NAMES})"
+            )
+        self.name = name
+        self.pc = pc
+        self.inputs: List[Tensor] = list(inputs)
+        self.output: Tensor = None  # set by subclass
+
+    # ---- parameters ----------------------------------------------------
+
+    def init_params(self, rng) -> Dict:
+        """Init trainable params (reference: per-op INIT_PARA tasks, e.g.
+        conv_2d.cu:374-419). {} for parameterless ops."""
+        return {}
+
+    def init_state(self) -> Dict:
+        """Non-trainable state (e.g. batch-norm running stats)."""
+        return {}
+
+    # ---- compute -------------------------------------------------------
+
+    def forward(self, params: Dict, state: Dict, xs: List, train: bool):
+        """Pure forward. Returns (output, new_state)."""
+        raise NotImplementedError
+
+    # ---- sharding ------------------------------------------------------
+
+    def output_spec(self):
+        """PartitionSpec of the output over AXIS_NAMES."""
+        raise NotImplementedError
+
+    def param_specs(self) -> Dict:
+        """PartitionSpec per param leaf (same tree structure as
+        init_params)."""
+        return {}
+
+    def output_sharding(self, machine):
+        return machine.sharding(self.pc, self.AXIS_NAMES, self.output_spec())
+
+    def param_shardings(self, machine) -> Dict:
+        """Shardings for placing params as jit inputs (canonical device
+        assignment; see MachineModel.input_sharding)."""
+        return {
+            k: machine.input_sharding(self.pc, self.AXIS_NAMES, spec)
+            for k, spec in self.param_specs().items()
+        }
+
+    # ---- cost model hooks (consumed by the simulator) ------------------
+
+    def flops_per_sample(self) -> float:
+        """Forward FLOPs per sample (fwd+bwd modeled as 3x by the sim)."""
+        return 0.0
+
+    def param_bytes(self) -> int:
+        return 0
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(name={self.name!r}, grid={self.pc.dims}, "
+                f"out={self.output.shape if self.output else None})")

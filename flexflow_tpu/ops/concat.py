@@ -1,0 +1,36 @@
+"""Concat along the channel dim (reference: concat.cu — per-input
+cudaMemcpyAsync, requiring all inputs to share the op's partition,
+concat.cu:93-98).  On TPU: jnp.concatenate on the channel axis; inputs with
+different producer grids are resharded to this op's grid by GSPMD first —
+the constraint the reference asserts is handled, not required."""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class Concat(Op):
+    AXIS_NAMES = ("w", "h", "c", "n")
+
+    def __init__(self, name: str, pc: ParallelConfig, inputs: List[Tensor]):
+        super().__init__(name, pc, inputs)
+        assert len(inputs) >= 2
+        n, h, w, _ = inputs[0].shape
+        for t in inputs:
+            assert t.ndim == 4 and t.shape[0] == n and t.shape[1] == h \
+                and t.shape[2] == w, "concat inputs must agree on N,H,W"
+        c_total = sum(t.shape[3] for t in inputs)
+        self.output = Tensor((n, h, w, c_total), inputs[0].dtype, self, name)
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", "h", "w", "c")
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax.numpy as jnp
+
+        return jnp.concatenate(xs, axis=3), state

@@ -1,0 +1,94 @@
+"""Conv2D (reference: conv_2d.cu, 874 LoC of Legion partitions + cuDNN).
+
+TPU-native: one ``lax.conv_general_dilated`` in NHWC/HWIO form (MXU path),
+with the op's {w,h,c,n} partition grid applied as a GSPMD sharding.  The
+reference's machinery maps as follows:
+
+  * 4-D task grid (conv_2d.cu:61-75)      -> mesh axes ("w","h","c","n")
+  * output partition-by-restriction        -> NamedSharding P(n,h,w,c)
+  * halo-free input re-partitioning        -> GSPMD spatial partitioning
+    (conv_2d.cu:171-208)                      (XLA inserts halo exchanges)
+  * replicated kernel/bias + updateGAS     -> weights sharded over 'c',
+    (conv_2d.cu:115-131, 747-814)             replicated over n/h/w; GSPMD
+                                              psums the gradient
+  * Xavier-uniform init (conv_2d.cu:399)   -> glorot_uniform
+  * fused bias + optional ReLU             -> same fusion, by XLA
+    (conv_2d.cu:523-536)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class Conv2D(Op):
+    AXIS_NAMES = ("w", "h", "c", "n")
+
+    def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
+                 out_channels: int, kernel_h: int, kernel_w: int,
+                 stride_h: int, stride_w: int, padding_h: int, padding_w: int,
+                 relu: bool = False):
+        super().__init__(name, pc, [input])
+        assert input.ndim == 4, "conv2d input must be NHWC"
+        n, h, w, cin = input.shape
+        self.in_channels = cin
+        self.out_channels = out_channels
+        self.kernel_h, self.kernel_w = kernel_h, kernel_w
+        self.stride_h, self.stride_w = stride_h, stride_w
+        self.padding_h, self.padding_w = padding_h, padding_w
+        self.relu = relu
+        # output extents: conv_2d.cu:65-68
+        out_h = 1 + (h + 2 * padding_h - kernel_h) // stride_h
+        out_w = 1 + (w + 2 * padding_w - kernel_w) // stride_w
+        self.output = Tensor((n, out_h, out_w, out_channels),
+                             input.dtype, self, name)
+
+    def init_params(self, rng) -> Dict:
+        import jax
+
+        kshape = (self.kernel_h, self.kernel_w,
+                  self.in_channels, self.out_channels)
+        kernel = jax.nn.initializers.glorot_uniform(in_axis=(0, 1, 2),
+                                                    out_axis=3)(
+            rng, kshape, "float32")
+        bias = jax.numpy.zeros((self.out_channels,), "float32")
+        return {"kernel": kernel, "bias": bias}
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return {"kernel": P(None, None, None, "c"), "bias": P("c")}
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", "h", "w", "c")
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax
+        from jax import lax
+
+        (x,) = xs
+        kernel = params["kernel"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, kernel,
+            window_strides=(self.stride_h, self.stride_w),
+            padding=((self.padding_h, self.padding_h),
+                     (self.padding_w, self.padding_w)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = y + params["bias"].astype(y.dtype)
+        if self.relu:
+            y = jax.nn.relu(y)
+        return y, state
+
+    def flops_per_sample(self) -> float:
+        _, oh, ow, oc = self.output.shape
+        return 2.0 * oh * ow * oc * self.kernel_h * self.kernel_w * self.in_channels
+
+    def param_bytes(self) -> int:
+        return 4 * (self.kernel_h * self.kernel_w * self.in_channels
+                    * self.out_channels + self.out_channels)

@@ -1,0 +1,83 @@
+"""BatchNorm (reference: batch_norm.cu, cudnnBatchNormalizationForward
+Training/Backward in SPATIAL mode; scale init 1.0, bias init 0.0,
+batch_norm.cu:225-239).
+
+Design divergence, on purpose: the reference computes batch statistics *per
+task shard* (each Legion task calls cuDNN BN on its local slice — no
+cross-shard sync), which makes training dynamics depend on the partition
+grid.  We compute **global** batch statistics: ``jnp.mean`` over sharded
+axes makes XLA insert the cross-shard reduction, i.e. sync-BN over the
+{n,h,w} grid axes.  This preserves the framework's key invariant — identical
+loss trajectories under any strategy (SURVEY.md §4) — which local BN breaks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class BatchNorm(Op):
+    AXIS_NAMES = ("w", "h", "c", "n")
+
+    def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
+                 relu: bool = True, eps: float = 1e-5, momentum: float = 0.9):
+        super().__init__(name, pc, [input])
+        assert input.ndim == 4
+        self.channels = input.shape[3]
+        self.relu = relu
+        self.eps = eps
+        self.momentum = momentum
+        self.output = Tensor(input.shape, input.dtype, self, name)
+
+    def init_params(self, rng) -> Dict:
+        import jax.numpy as jnp
+
+        return {"scale": jnp.ones((self.channels,), "float32"),
+                "bias": jnp.zeros((self.channels,), "float32")}
+
+    def init_state(self) -> Dict:
+        import jax.numpy as jnp
+
+        return {"mean": jnp.zeros((self.channels,), "float32"),
+                "var": jnp.ones((self.channels,), "float32")}
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return {"scale": P("c"), "bias": P("c")}
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", "h", "w", "c")
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax
+        import jax.numpy as jnp
+
+        (x,) = xs
+        xf = x.astype("float32")
+        if train:
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            var = jnp.var(xf, axis=(0, 1, 2))
+            m = self.momentum
+            state = {"mean": m * state["mean"] + (1 - m) * mean,
+                     "var": m * state["var"] + (1 - m) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+        inv = jax.lax.rsqrt(var + self.eps) * params["scale"]
+        y = (xf - mean) * inv + params["bias"]
+        y = y.astype(x.dtype)
+        if self.relu:
+            y = jax.nn.relu(y)
+        return y, state
+
+    def flops_per_sample(self) -> float:
+        _, h, w, c = self.output.shape
+        return 8.0 * h * w * c
+
+    def param_bytes(self) -> int:
+        return 4 * 2 * self.channels

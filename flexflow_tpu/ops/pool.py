@@ -1,0 +1,66 @@
+"""Pool2D (reference: pool_2d.cu, cudnnPoolingForward/Backward).
+
+``lax.reduce_window`` max/avg in NHWC; the {w,h,c,n} grid shards the
+activation, and XLA handles window halos under spatial partitioning.
+Defaults mirror the reference API: ``pool2d(..., POOL_MAX, relu=True)``
+(model.h:133-139, pool_2d.cu:50-56)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+POOL_MAX = "max"
+POOL_AVG = "avg"
+
+
+class Pool2D(Op):
+    AXIS_NAMES = ("w", "h", "c", "n")
+
+    def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
+                 kernel_h: int, kernel_w: int, stride_h: int, stride_w: int,
+                 padding_h: int, padding_w: int,
+                 pool_type: str = POOL_MAX, relu: bool = True):
+        super().__init__(name, pc, [input])
+        assert input.ndim == 4
+        n, h, w, c = input.shape
+        self.kernel_h, self.kernel_w = kernel_h, kernel_w
+        self.stride_h, self.stride_w = stride_h, stride_w
+        self.padding_h, self.padding_w = padding_h, padding_w
+        self.pool_type = pool_type
+        self.relu = relu
+        out_h = 1 + (h + 2 * padding_h - kernel_h) // stride_h
+        out_w = 1 + (w + 2 * padding_w - kernel_w) // stride_w
+        self.output = Tensor((n, out_h, out_w, c), input.dtype, self, name)
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", "h", "w", "c")
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        (x,) = xs
+        window = (1, self.kernel_h, self.kernel_w, 1)
+        strides = (1, self.stride_h, self.stride_w, 1)
+        pads = ((0, 0), (self.padding_h, self.padding_h),
+                (self.padding_w, self.padding_w), (0, 0))
+        if self.pool_type == POOL_MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        else:
+            ones = jnp.ones_like(x)
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+            y = s / cnt
+        if self.relu:
+            y = jax.nn.relu(y)
+        return y, state
+
+    def flops_per_sample(self) -> float:
+        _, oh, ow, c = self.output.shape
+        return float(oh * ow * c * self.kernel_h * self.kernel_w)

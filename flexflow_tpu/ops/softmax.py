@@ -1,0 +1,54 @@
+"""Softmax + cross-entropy loss (fused), data-parallel over the batch.
+
+Reference: softmax.cu — 1-D grid over batch only (softmax.cu:19-26),
+cudnnSoftmaxForward, and a backward that is the fused CE gradient
+(probs - onehot)/batch (softmax.cu:210-217, 271-278).
+
+TPU-native: log-softmax + NLL with jax.grad providing the same fused
+gradient.  Normalization fix (SURVEY.md §7 "hard parts"): the reference
+scales by 1/local-batch per shard; we define the loss as the mean over the
+*global* batch, which is shard-count invariant — the property the
+strategy-invariance tests rely on.
+
+Unlike the reference (which never reports loss — SURVEY.md §5), forward also
+returns the scalar loss for metrics.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class Softmax(Op):
+    AXIS_NAMES = ("n",)
+    is_loss = True
+
+    def __init__(self, name: str, pc: ParallelConfig, input: Tensor):
+        super().__init__(name, pc, [input])
+        assert input.ndim == 2
+        self.num_classes = input.shape[1]
+        self.output = Tensor(input.shape, input.dtype, self, name)
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", None)
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax
+
+        (x,) = xs
+        return jax.nn.log_softmax(x.astype("float32"), axis=-1), state
+
+    def loss(self, log_probs, labels):
+        """Mean NLL over the global batch; labels are int class ids."""
+        import jax.numpy as jnp
+
+        nll = -jnp.take_along_axis(log_probs, labels[:, None], axis=1)
+        return jnp.mean(nll)
+
+    def flops_per_sample(self) -> float:
+        return 5.0 * self.num_classes

@@ -1,0 +1,30 @@
+"""Test config: force an 8-device virtual CPU mesh so multi-chip sharding is
+exercised without TPU hardware (SURVEY.md §4: the stand-in for the
+reference's ability to test multi-node via DISABLE_COMPUTATION + the
+simulator).  Must run before jax initializes a backend; the axon
+sitecustomize pre-imports jax, so we use jax.config rather than env vars."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def machine8():
+    from flexflow_tpu.machine import MachineModel
+
+    assert jax.device_count() == 8
+    return MachineModel()
+
+
+@pytest.fixture(scope="session")
+def machine1():
+    from flexflow_tpu.machine import MachineModel
+
+    return MachineModel(devices=jax.devices()[:1])

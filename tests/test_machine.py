@@ -1,0 +1,53 @@
+"""MachineModel / mesh construction tests."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.machine import MachineModel, Topology
+from flexflow_tpu.strategy import ParallelConfig
+
+
+def test_mesh_for_grid(machine8):
+    pc = ParallelConfig((1, 1, 2, 4), tuple(range(8)))
+    mesh = machine8.mesh_for(pc, ("w", "h", "c", "n"))
+    assert dict(mesh.shape) == {"w": 1, "h": 1, "c": 2, "n": 4}
+    # mesh array axes are reversed grid order: indexed [n, c, h, w];
+    # dim0-fastest linearization puts grid point c=1 at device ordinal 1
+    assert mesh.devices[0, 1, 0, 0].id == machine8.devices[1].id
+    # row-major flattening equals the devices tuple (canonical assignment)
+    assert [d.id for d in mesh.devices.flat] == \
+        [machine8.devices[i].id for i in range(8)]
+
+
+def test_mesh_cache(machine8):
+    pc = ParallelConfig((8,), tuple(range(8)))
+    m1 = machine8.mesh_for(pc, ("n",))
+    m2 = machine8.mesh_for(pc, ("n",))
+    assert m1 is m2
+
+
+def test_mesh_device_subset(machine8):
+    pc = ParallelConfig((4,), (4, 5, 6, 7))
+    mesh = machine8.mesh_for(pc, ("n",))
+    assert [d.id for d in mesh.devices.flat] == \
+        [machine8.devices[i].id for i in (4, 5, 6, 7)]
+
+
+def test_sharding_places_data(machine8):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    pc = ParallelConfig((2, 4), tuple(range(8)))
+    sh = machine8.sharding(pc, ("c", "n"), P("n", "c"))
+    x = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8), sh)
+    assert x.sharding.is_equivalent_to(sh, 2)
+    # each device holds a (2, 4) tile
+    assert x.addressable_shards[0].data.shape == (2, 4)
+
+
+def test_topology_tiers():
+    topo = Topology(devices_per_ici_group=4, ici_bandwidth=9e10,
+                    dcn_bandwidth=2.5e10)
+    assert topo.bandwidth(0, 0) == float("inf")
+    assert topo.bandwidth(0, 3) == 9e10
+    assert topo.bandwidth(0, 4) == 2.5e10

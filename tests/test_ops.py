@@ -1,0 +1,138 @@
+"""Per-op numeric parity vs plain jax/numpy references (SURVEY.md §4 test
+pyramid level 1), on the 8-device CPU mesh with non-trivial grids."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.ops import Conv2D, Pool2D, Linear, Flat, Softmax, Concat
+from flexflow_tpu.ops.norm import BatchNorm
+from flexflow_tpu.ops.base import Tensor
+from flexflow_tpu.ops.pool import POOL_AVG
+from flexflow_tpu.strategy import ParallelConfig
+
+
+def pc4(w=1, h=1, c=1, n=1, devs=None):
+    total = w * h * c * n
+    return ParallelConfig((w, h, c, n),
+                          tuple(devs) if devs else tuple(range(total)))
+
+
+def run(op, xs, params=None, state=None, train=True):
+    params = params if params is not None else op.init_params(
+        jax.random.PRNGKey(0))
+    state = state if state is not None else op.init_state()
+    y, st = op.forward(params, state, xs, train)
+    return np.asarray(y), params, st
+
+
+def test_conv2d_matches_lax():
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 12, 12, 3),
+                    dtype=jnp.float32)
+    t = Tensor((4, 12, 12, 3))
+    op = Conv2D("c", pc4(n=1), t, out_channels=8, kernel_h=3, kernel_w=3,
+                stride_h=2, stride_w=2, padding_h=1, padding_w=1, relu=True)
+    assert op.output.shape == (4, 6, 6, 8)
+    y, params, _ = run(op, [x])
+    ref = jax.lax.conv_general_dilated(
+        x, params["kernel"], (2, 2), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    ref = jax.nn.relu(ref + params["bias"])
+    np.testing.assert_allclose(y, np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_pool2d_max_and_avg():
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 6, 6, 4),
+                    dtype=jnp.float32)
+    t = Tensor((2, 6, 6, 4))
+    op = Pool2D("p", pc4(), t, 2, 2, 2, 2, 0, 0, relu=False)
+    y, _, _ = run(op, [x])
+    ref = np.asarray(x).reshape(2, 3, 2, 3, 2, 4).max(axis=(2, 4))
+    np.testing.assert_allclose(y, ref, rtol=1e-6)
+
+    op = Pool2D("p2", pc4(), t, 2, 2, 2, 2, 0, 0, pool_type=POOL_AVG,
+                relu=False)
+    y, _, _ = run(op, [x])
+    ref = np.asarray(x).reshape(2, 3, 2, 3, 2, 4).mean(axis=(2, 4))
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_linear_matches_numpy():
+    x = jnp.asarray(np.random.RandomState(2).randn(8, 16), dtype=jnp.float32)
+    t = Tensor((8, 16))
+    op = Linear("l", ParallelConfig((1, 1), (0,)), t, 32, relu=True)
+    y, params, _ = run(op, [x])
+    ref = np.maximum(np.asarray(x) @ np.asarray(params["kernel"])
+                     + np.asarray(params["bias"]), 0)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_flat():
+    x = jnp.arange(2 * 3 * 3 * 4, dtype=jnp.float32).reshape(2, 3, 3, 4)
+    op = Flat("f", ParallelConfig((1, 1), (0,)), Tensor((2, 3, 3, 4)))
+    y, _, _ = run(op, [x])
+    assert y.shape == (2, 36)
+    np.testing.assert_allclose(y, np.asarray(x).reshape(2, 36))
+
+
+def test_softmax_loss():
+    logits = jnp.asarray(np.random.RandomState(3).randn(8, 10),
+                         dtype=jnp.float32)
+    labels = jnp.asarray(np.arange(8) % 10, dtype=jnp.int32)
+    op = Softmax("s", ParallelConfig((1,), (0,)), Tensor((8, 10)))
+    lp, _, _ = run(op, [logits])
+    loss = float(op.loss(jnp.asarray(lp), labels))
+    e = np.exp(np.asarray(logits) - np.asarray(logits).max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    ref = -np.mean(np.log(p[np.arange(8), np.asarray(labels)]))
+    assert abs(loss - ref) < 1e-5
+
+
+def test_concat():
+    a = jnp.ones((2, 3, 3, 4))
+    b = jnp.zeros((2, 3, 3, 2))
+    op = Concat("cat", pc4(), [Tensor((2, 3, 3, 4)), Tensor((2, 3, 3, 2))])
+    assert op.output.shape == (2, 3, 3, 6)
+    y, _, _ = run(op, [a, b])
+    assert y.shape == (2, 3, 3, 6)
+    np.testing.assert_allclose(y[..., :4], 1.0)
+    np.testing.assert_allclose(y[..., 4:], 0.0)
+
+
+def test_batchnorm_train_normalizes():
+    x = jnp.asarray(np.random.RandomState(4).randn(8, 4, 4, 3) * 5 + 2,
+                    dtype=jnp.float32)
+    op = BatchNorm("bn", pc4(), Tensor((8, 4, 4, 3)), relu=False)
+    y, params, st = run(op, [x])
+    assert abs(y.mean()) < 1e-4
+    assert abs(y.std() - 1.0) < 1e-2
+    # running stats moved toward batch stats
+    assert np.all(np.asarray(st["mean"]) != 0.0)
+
+
+def test_sharded_op_matches_single_device(machine8):
+    """Same conv numeric result whether computed unsharded or under a
+    nontrivial {w,h,c,n} grid (partition-invariance at the op level)."""
+    from jax.sharding import PartitionSpec as P
+
+    x_np = np.random.RandomState(5).randn(8, 8, 8, 4).astype(np.float32)
+    t = Tensor((8, 8, 8, 4))
+    op = Conv2D("c", pc4(w=2, h=2, c=1, n=2), t, 8, 3, 3, 1, 1, 1, 1,
+                relu=True)
+    params = op.init_params(jax.random.PRNGKey(0))
+
+    y_plain = np.asarray(op.forward(params, {}, [jnp.asarray(x_np)], True)[0])
+
+    sh = op.output_sharding(machine8)
+    xin = jax.device_put(x_np, machine8.sharding(
+        op.pc, op.AXIS_NAMES, P("n", "h", "w", None)))
+
+    @jax.jit
+    def f(p, x):
+        y, _ = op.forward(p, {}, [x], True)
+        return jax.lax.with_sharding_constraint(y, sh)
+
+    y_sharded = np.asarray(f(params, xin))
+    np.testing.assert_allclose(y_sharded, y_plain, rtol=1e-4, atol=1e-5)
