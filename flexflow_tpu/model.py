@@ -58,6 +58,7 @@ class FFModel:
         return pc
 
     def _add(self, op: Op) -> Tensor:
+        op.validate_partitioning()
         self.layers.append(op)
         return op.output
 
@@ -91,6 +92,11 @@ class FFModel:
     def concat(self, name, tensors: List[Tensor]) -> Tensor:
         return self._add(Concat(name, self._pc(name, 4), tensors))
 
+    def add(self, name, x: Tensor, y: Tensor, relu: bool = False) -> Tensor:
+        from flexflow_tpu.ops.elementwise import Add
+
+        return self._add(Add(name, self._pc(name, 4), [x, y], relu))
+
     def flat(self, name, input) -> Tensor:
         return self._add(Flat(name, self._pc(name, 2), input))
 
@@ -111,14 +117,17 @@ class FFModel:
         params: Dict[str, Dict] = {}
         state: Dict[str, Dict] = {}
         for op in self.layers:
-            key, sub = jax.random.split(key)
-            p = op.init_params(sub)
-            if p:
-                shardings = op.param_shardings(self.machine)
-                params[op.name] = {
-                    k: jax.device_put(v, shardings[k]) for k, v in p.items()
-                }
-            s = op.init_state()
+            if op.param_key not in params:
+                # shared weights: first op with the key initializes
+                key, sub = jax.random.split(key)
+                p = op.init_params(sub)
+                if p:
+                    shardings = op.param_shardings(self.machine)
+                    params[op.param_key] = {
+                        k: jax.device_put(v, shardings[k])
+                        for k, v in p.items()
+                    }
+            s = op.init_state()  # state is per-op even under shared params
             if s:
                 state[op.name] = s
         return params, state
@@ -147,12 +156,15 @@ class FFModel:
         new_state: Dict[str, Dict] = {}
         for op in self.layers:
             xs = [values[t.tid] for t in op.inputs]
-            y, st = op.forward(params.get(op.name, {}),
-                               state.get(op.name, {}), xs, train)
-            if multi:
-                y = lax.with_sharding_constraint(
-                    y, op.output_sharding(self.machine))
-            values[op.output.tid] = y
+            res, st = op.forward(params.get(op.param_key, {}),
+                                 state.get(op.name, {}), xs, train)
+            outs = op.outputs if op.outputs else [op.output]
+            ys = res if isinstance(res, tuple) else (res,)
+            for t, y, spec in zip(outs, ys, op.output_specs()):
+                if multi and spec is not None:
+                    y = lax.with_sharding_constraint(
+                        y, self.machine.sharding(op.pc, op.AXIS_NAMES, spec))
+                values[t.tid] = y
             if st:
                 new_state[op.name] = st
         return values, new_state

@@ -3,5 +3,17 @@ DenseNet-121, NMT seq2seq — built through the FFModel layer API so every
 layer picks up its strategy entry."""
 
 from flexflow_tpu.models.alexnet import add_alexnet_layers, build_alexnet
+from flexflow_tpu.models.vgg import add_vgg16_layers, build_vgg16
+from flexflow_tpu.models.inception import (add_inception_v3_layers,
+                                           build_inception_v3)
+from flexflow_tpu.models.resnet import add_resnet101_layers, build_resnet101
+from flexflow_tpu.models.densenet import (add_densenet121_layers,
+                                          build_densenet121)
 
-__all__ = ["add_alexnet_layers", "build_alexnet"]
+__all__ = [
+    "add_alexnet_layers", "build_alexnet",
+    "add_vgg16_layers", "build_vgg16",
+    "add_inception_v3_layers", "build_inception_v3",
+    "add_resnet101_layers", "build_resnet101",
+    "add_densenet121_layers", "build_densenet121",
+]

@@ -1,0 +1,60 @@
+"""DenseNet-121 — parity with the reference's USE_DENSENET model
+(cnn.cc:217-236; DenseBlock/Transition inception.h:100-120)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import FFModel, Tensor
+from flexflow_tpu.ops.pool import POOL_AVG
+
+
+def dense_block(ff: FFModel, p: str, input: Tensor, num_layers: int,
+                growth_rate: int) -> Tensor:
+    last = input
+    for i in range(num_layers):
+        t = ff.batch_norm(f"{p}_l{i}_bn1", last, relu=True)
+        t = ff.conv2d(f"{p}_l{i}_conv1", t, 4 * growth_rate, 1, 1, 1, 1,
+                      0, 0, relu=False)
+        t = ff.batch_norm(f"{p}_l{i}_bn2", t, relu=True)
+        t = ff.conv2d(f"{p}_l{i}_conv2", t, growth_rate, 3, 3, 1, 1, 1, 1,
+                      relu=False)
+        last = ff.concat(f"{p}_l{i}_concat", [last, t])
+    return last
+
+
+def transition(ff: FFModel, p: str, input: Tensor, output_size: int) -> Tensor:
+    t = ff.conv2d(f"{p}_conv", input, output_size, 1, 1, 1, 1, 0, 0,
+                  relu=True)
+    return ff.pool2d(f"{p}_pool", t, 2, 2, 2, 2, 0, 0, pool_type=POOL_AVG,
+                     relu=False)
+
+
+def add_densenet121_layers(ff: FFModel, image: Tensor) -> Tensor:
+    t = ff.conv2d("conv1", image, 64, 7, 7, 2, 2, 3, 3, relu=False)
+    t = ff.batch_norm("bn1", t, relu=True)
+    t = ff.pool2d("pool1", t, 3, 3, 2, 2, 1, 1)
+    num_features = 64
+    t = dense_block(ff, "dense1", t, 6, 32)
+    num_features = (num_features + 32 * 6) // 2
+    t = transition(ff, "trans1", t, num_features)
+    t = dense_block(ff, "dense2", t, 12, 32)
+    num_features = (num_features + 32 * 12) // 2
+    t = transition(ff, "trans2", t, num_features)
+    t = dense_block(ff, "dense3", t, 24, 32)
+    num_features = (num_features + 32 * 24) // 2
+    t = transition(ff, "trans3", t, num_features)
+    t = dense_block(ff, "dense4", t, 16, 32)
+    t = ff.pool2d("pool2", t, 7, 7, 1, 1, 0, 0, pool_type=POOL_AVG,
+                  relu=False)
+    t = ff.flat("flat", t)
+    t = ff.linear("linear1", t, 1000, relu=False)
+    return ff.softmax("softmax", t)
+
+
+def build_densenet121(config: FFConfig = None, machine=None) -> FFModel:
+    ff = FFModel(config, machine)
+    cfg = ff.config
+    image = ff.create_input(
+        (cfg.batch_size, cfg.input_height, cfg.input_width, 3), name="image")
+    add_densenet121_layers(ff, image)
+    return ff

@@ -1,0 +1,32 @@
+"""VGG-16 — layer parity with the reference's USE_VGG model (cnn.cc:164-188;
+legacy API add_conv_layer defaults relu=true)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import FFModel, Tensor
+
+
+def add_vgg16_layers(ff: FFModel, image: Tensor) -> Tensor:
+    t = image
+    plan = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+    li = 0
+    for bi, (ch, reps) in enumerate(plan):
+        for _ in range(reps):
+            li += 1
+            t = ff.conv2d(f"conv{li}", t, ch, 3, 3, 1, 1, 1, 1, relu=True)
+        t = ff.pool2d(f"pool{bi + 1}", t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat("flat", t)
+    t = ff.linear("linear1", t, 4096)
+    t = ff.linear("linear2", t, 4096)
+    t = ff.linear("linear3", t, 1000, relu=False)
+    return ff.softmax("softmax", t)
+
+
+def build_vgg16(config: FFConfig = None, machine=None) -> FFModel:
+    ff = FFModel(config, machine)
+    cfg = ff.config
+    image = ff.create_input(
+        (cfg.batch_size, cfg.input_height, cfg.input_width, 3), name="image")
+    add_vgg16_layers(ff, image)
+    return ff

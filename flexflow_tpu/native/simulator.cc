@@ -1,0 +1,304 @@
+// Task-graph execution simulator + Metropolis MCMC strategy search.
+//
+// Native core of the strategy-search subsystem (the role of the reference's
+// scripts/simulator.cc, re-designed): Python precomputes, for every op and
+// every candidate ParallelConfig, the per-shard compute cost and the shard
+// rectangles (output tile + input footprint per grid point, each pinned to a
+// device).  This C++ library owns the hot loop: rectangle-intersection
+// derived communication, two-tier (ICI/DCN) transfer costing, greedy
+// list-scheduling by per-device ready time, parameter-sync costing, and the
+// MCMC search over per-op config assignments.
+//
+// Exposed as a C ABI consumed via ctypes (flexflow_tpu/sim/native.py).
+//
+// Serialized input schema (two flat buffers):
+//   ints:
+//     n_devices, group_size,
+//     n_ops,
+//     per op:
+//       n_inputs, producer_op_id[n_inputs] (-1 = graph input),
+//       n_configs,
+//       per config:
+//         n_points,
+//         per point:
+//           device_id,
+//           out_rect[8]   (lo0,hi0,...,lo3,hi3; hi exclusive; unused dims 0/1)
+//           in_rect[8] x n_inputs
+//   doubles:
+//     intra_bw, cross_bw, latency,          (bytes/sec, sec)
+//     per op: param_bytes,
+//     per op, per config: compute_cost,     (sec, fwd+bwd per step)
+//     per op, per config: param_replicas    (gradient copies to merge)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <map>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Rect {
+  int64_t lo[4], hi[4];  // hi exclusive
+  int64_t volume() const {
+    int64_t v = 1;
+    for (int d = 0; d < 4; d++) {
+      int64_t e = hi[d] - lo[d];
+      if (e <= 0) return 0;
+      v *= e;
+    }
+    return v;
+  }
+};
+
+inline int64_t intersect_volume(const Rect& a, const Rect& b) {
+  int64_t v = 1;
+  for (int d = 0; d < 4; d++) {
+    int64_t lo = a.lo[d] > b.lo[d] ? a.lo[d] : b.lo[d];
+    int64_t hi = a.hi[d] < b.hi[d] ? a.hi[d] : b.hi[d];
+    if (hi <= lo) return 0;
+    v *= hi - lo;
+  }
+  return v;
+}
+
+struct Point {
+  int device;
+  Rect out;
+  std::vector<Rect> in;  // one footprint per op input
+};
+
+struct Config {
+  std::vector<Point> points;
+  double compute_cost = 0.0;
+  double param_replicas = 1.0;
+};
+
+struct OpNode {
+  std::vector<int> producers;  // per input: producer op id or -1
+  std::vector<Config> configs;
+  double param_bytes = 0.0;
+};
+
+// One producer-shard -> consumer-shard transfer.
+struct Xfer {
+  int src_point, dst_point;
+  double bytes;
+};
+
+struct Simulator {
+  int n_devices = 1, group_size = 1;
+  double intra_bw = 1.0, cross_bw = 1.0, latency = 0.0;
+  std::vector<OpNode> ops;
+  // memo: (dst_op, input_idx, src_cfg, dst_cfg) -> transfer list
+  std::map<std::tuple<int, int, int, int>, std::vector<Xfer>> xfer_cache;
+
+  double bw(int da, int db) const {
+    if (da == db) return 0.0;  // marker: no transfer cost
+    if (da / group_size == db / group_size) return intra_bw;
+    return cross_bw;
+  }
+
+  const std::vector<Xfer>& transfers(int dst_op, int input_idx, int src_cfg,
+                                     int dst_cfg) {
+    auto key = std::make_tuple(dst_op, input_idx, src_cfg, dst_cfg);
+    auto it = xfer_cache.find(key);
+    if (it != xfer_cache.end()) return it->second;
+    std::vector<Xfer> xs;
+    int src_op = ops[dst_op].producers[input_idx];
+    const auto& sp = ops[src_op].configs[src_cfg].points;
+    const auto& dp = ops[dst_op].configs[dst_cfg].points;
+    for (size_t j = 0; j < dp.size(); j++) {
+      const Rect& need = dp[j].in[input_idx];
+      for (size_t i = 0; i < sp.size(); i++) {
+        int64_t v = intersect_volume(sp[i].out, need);
+        if (v > 0 && sp[i].device != dp[j].device) {
+          xs.push_back({(int)i, (int)j, (double)v * 4.0});
+        }
+      }
+    }
+    auto res = xfer_cache.emplace(key, std::move(xs));
+    return res.first->second;
+  }
+
+  // Makespan of one training step under `assign` (config index per op).
+  // Ops arrive in topological order (graph is built front-to-back).
+  double simulate(const std::vector<int>& assign) {
+    size_t n = ops.size();
+    // finish time per (op, point)
+    std::vector<std::vector<double>> finish(n);
+    std::vector<double> dev_free(n_devices, 0.0);
+    double makespan = 0.0;
+    for (size_t o = 0; o < n; o++) {
+      const Config& cfg = ops[o].configs[assign[o]];
+      size_t np = cfg.points.size();
+      std::vector<double> ready(np, 0.0);
+      // dependency + comm arrival times
+      for (size_t inp = 0; inp < ops[o].producers.size(); inp++) {
+        int src = ops[o].producers[inp];
+        if (src < 0) continue;
+        const auto& sf = finish[src];
+        const auto& sp = ops[src].configs[assign[src]].points;
+        // same-device or overlapping producers must finish first
+        for (size_t j = 0; j < np; j++) {
+          const Rect& need = cfg.points[j].in[inp];
+          for (size_t i = 0; i < sp.size(); i++) {
+            if (intersect_volume(sp[i].out, need) > 0 && sf[i] > ready[j])
+              ready[j] = sf[i];
+          }
+        }
+        for (const Xfer& x :
+             transfers((int)o, (int)inp, assign[src], assign[o])) {
+          double t = sf[x.src_point] + latency +
+                     x.bytes / bw(sp[x.src_point].device,
+                                  cfg.points[x.dst_point].device);
+          if (t > ready[x.dst_point]) ready[x.dst_point] = t;
+        }
+      }
+      // per-shard compute, serialized per device by list scheduling
+      double per_point = cfg.compute_cost;
+      finish[o].resize(np);
+      for (size_t j = 0; j < np; j++) {
+        int d = cfg.points[j].device;
+        double start = ready[j] > dev_free[d] ? ready[j] : dev_free[d];
+        double end = start + per_point;
+        dev_free[d] = end;
+        finish[o][j] = end;
+        if (end > makespan) makespan = end;
+      }
+    }
+    // parameter synchronization: merging gradient replicas, two-tier
+    // (reference update() models, scripts-equivalent semantics)
+    double sync = 0.0;
+    for (size_t o = 0; o < n; o++) {
+      if (ops[o].param_bytes <= 0.0) continue;
+      const Config& cfg = ops[o].configs[assign[o]];
+      double r = cfg.param_replicas;
+      if (r <= 1.0) continue;
+      // devices of this config grouped by node
+      std::vector<char> dev_seen(n_devices, 0);
+      std::vector<char> grp_seen(n_devices / group_size + 1, 0);
+      int ndev = 0, ngrp = 0;
+      for (const Point& p : cfg.points) {
+        if (!dev_seen[p.device]) { dev_seen[p.device] = 1; ndev++; }
+        int g = p.device / group_size;
+        if (!grp_seen[g]) { grp_seen[g] = 1; ngrp++; }
+      }
+      double shard_bytes = ops[o].param_bytes / ((double)cfg.points.size() / r);
+      int intra_cnt = ndev > ngrp ? ndev - ngrp : 0;
+      sync += intra_cnt > 0 ? shard_bytes * intra_cnt / ((double)intra_cnt + 1)
+                                  * 2.0 / intra_bw : 0.0;
+      sync += ngrp > 1 ? shard_bytes * 2.0 * (ngrp - 1) / ngrp / cross_bw : 0.0;
+    }
+    return makespan + sync;
+  }
+};
+
+int64_t read_i(const int64_t*& p) { return *p++; }
+
+}  // namespace
+
+extern "C" {
+
+// Build a simulator from the serialized buffers. Returns opaque handle.
+void* ffsim_create(const int64_t* ints, int64_t n_ints, const double* dbls,
+                   int64_t n_dbls) {
+  (void)n_ints;
+  Simulator* sim = new Simulator();
+  const int64_t* ip = ints;
+  sim->n_devices = (int)read_i(ip);
+  sim->group_size = (int)read_i(ip);
+  if (sim->group_size <= 0) sim->group_size = sim->n_devices;
+  int64_t n_ops = read_i(ip);
+  sim->ops.resize(n_ops);
+  const double* dp = dbls;
+  sim->intra_bw = *dp++;
+  sim->cross_bw = *dp++;
+  sim->latency = *dp++;
+  (void)n_dbls;
+  for (int64_t o = 0; o < n_ops; o++) {
+    OpNode& op = sim->ops[o];
+    int64_t n_inputs = read_i(ip);
+    op.producers.resize(n_inputs);
+    for (int64_t i = 0; i < n_inputs; i++)
+      op.producers[i] = (int)read_i(ip);
+    int64_t n_configs = read_i(ip);
+    op.configs.resize(n_configs);
+    for (int64_t c = 0; c < n_configs; c++) {
+      Config& cfg = op.configs[c];
+      int64_t n_points = read_i(ip);
+      cfg.points.resize(n_points);
+      for (int64_t pt = 0; pt < n_points; pt++) {
+        Point& point = cfg.points[pt];
+        point.device = (int)read_i(ip);
+        for (int d = 0; d < 4; d++) {
+          point.out.lo[d] = read_i(ip);
+          point.out.hi[d] = read_i(ip);
+        }
+        point.in.resize(n_inputs);
+        for (int64_t i = 0; i < n_inputs; i++) {
+          for (int d = 0; d < 4; d++) {
+            point.in[i].lo[d] = read_i(ip);
+            point.in[i].hi[d] = read_i(ip);
+          }
+        }
+      }
+    }
+  }
+  for (int64_t o = 0; o < n_ops; o++) sim->ops[o].param_bytes = *dp++;
+  for (int64_t o = 0; o < n_ops; o++)
+    for (auto& cfg : sim->ops[o].configs) cfg.compute_cost = *dp++;
+  for (int64_t o = 0; o < n_ops; o++)
+    for (auto& cfg : sim->ops[o].configs) cfg.param_replicas = *dp++;
+  return sim;
+}
+
+void ffsim_destroy(void* handle) { delete (Simulator*)handle; }
+
+double ffsim_simulate(void* handle, const int32_t* assign) {
+  Simulator* sim = (Simulator*)handle;
+  std::vector<int> a(sim->ops.size());
+  for (size_t i = 0; i < a.size(); i++) a[i] = assign[i];
+  return sim->simulate(a);
+}
+
+// Metropolis MCMC (reference: scripts/simulator.cc:1444-1471): start from
+// `assign`, `iters` proposals re-randomizing one op's config, accept better
+// moves always and worse moves with prob exp(-beta * delta).  Writes the
+// best assignment back into `assign`; returns its simulated time.
+double ffsim_mcmc(void* handle, int32_t* assign, int64_t iters, double beta,
+                  uint64_t seed) {
+  Simulator* sim = (Simulator*)handle;
+  size_t n = sim->ops.size();
+  std::vector<int> cur(n), best(n);
+  for (size_t i = 0; i < n; i++) cur[i] = best[i] = assign[i];
+  std::mt19937_64 rng(seed);
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+  double cur_t = sim->simulate(cur);
+  double best_t = cur_t;
+  for (int64_t it = 0; it < iters; it++) {
+    size_t o = rng() % n;
+    size_t nc = sim->ops[o].configs.size();
+    if (nc <= 1) continue;
+    int old = cur[o];
+    int prop = (int)(rng() % nc);
+    if (prop == old) continue;
+    cur[o] = prop;
+    double t = sim->simulate(cur);
+    if (t < cur_t || unif(rng) < std::exp(-beta * (t - cur_t))) {
+      cur_t = t;
+      if (t < best_t) {
+        best_t = t;
+        best = cur;
+      }
+    } else {
+      cur[o] = old;
+    }
+  }
+  for (size_t i = 0; i < n; i++) assign[i] = best[i];
+  return best_t;
+}
+
+}  // extern "C"

@@ -1,0 +1,6 @@
+"""NMT / RNN subsystem — TPU-native equivalent of the reference's second
+application (nmt/, self-contained seq2seq trainer)."""
+
+from flexflow_tpu.nmt.rnn_model import RnnConfig, RnnModel, default_global_config
+
+__all__ = ["RnnConfig", "RnnModel", "default_global_config"]

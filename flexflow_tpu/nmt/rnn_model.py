@@ -1,0 +1,247 @@
+"""RnnModel: seq2seq NMT trainer (reference: nmt/rnn.h:100-379,
+nmt/rnn.cu:61-336, driver nmt/nmt.cc).
+
+DAG parity (nmt/rnn.cu:298-326): the sequence is chopped into chunks of
+``lstm_per_node_length`` steps; each (layer, chunk) LSTM is an independent
+op with its own ParallelConfig; hidden state flows chunk -> chunk, outputs
+flow layer -> layer; decoder chunk 0 receives the last encoder chunk's
+state.  Per-chunk vocab projections share one weight; softmaxDP computes the
+chunk loss against the same chunk's dst tokens.
+
+Weight sharing (the reference's SharedVariable with its 2-level hand-rolled
+hierarchical allreduce, nmt/rnn.cu:650-703) is expressed by param_key
+sharing: jax.grad sums the chunk ops' contributions, and GSPMD emits the
+hierarchical reduction over ICI/DCN.
+
+Update rule parity: the reference applies ``w += -0.1 * grad_sum``
+(nmt/rnn.cu:684-702, rate -0.1, no normalization).  We keep SGD with the
+model's learning rate on the *summed* (not averaged) chunk gradients, and
+normalize the loss by total target tokens instead — document once, apply
+everywhere (SURVEY.md §7 normalization note)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.ops.base import Tensor
+from flexflow_tpu.ops.embed import Embed
+from flexflow_tpu.ops.lstm import LSTMChunk
+from flexflow_tpu.ops.rnn_linear import RnnLinear
+from flexflow_tpu.ops.seq import SliceSeq
+from flexflow_tpu.ops.softmax_dp import SoftmaxDP
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+
+@dataclasses.dataclass
+class RnnConfig:
+    """nmt/nmt.cc:34-44 defaults."""
+
+    batch_size: int = 64
+    num_layers: int = 2
+    seq_length: int = 20
+    hidden_size: int = 2048
+    embed_size: int = 2048
+    vocab_size: int = 20 * 1024
+    lstm_per_node_length: int = 10   # LSTM_PER_NODE_LENGTH, nmt/rnn.h:23
+    learning_rate: float = 0.1       # reference applies rate -0.1 updates
+    num_iterations: int = 10
+    compute_dtype: str = "float32"
+    seed: int = 0
+
+    @property
+    def chunks_per_seq(self) -> int:
+        return (self.seq_length + self.lstm_per_node_length - 1) \
+            // self.lstm_per_node_length
+
+
+def default_global_config(cfg: RnnConfig, machine: MachineModel) -> Strategy:
+    """set_global_config parity (nmt/nmt.cc:269-308): LSTMs/linear/softmax
+    data-parallel over all devices; embeds pinned (src -> device 0,
+    dst -> device 1)."""
+    s = Strategy()
+    n = machine.num_devices
+    devs = tuple(range(n))
+    npc = cfg.chunks_per_seq
+    for i in range(2 * npc):
+        pinned = 0 if i < npc else min(1, n - 1)
+        s[f"embed{i}"] = ParallelConfig((1,), (pinned,))
+    for l in range(cfg.num_layers):
+        for j in range(2 * npc):
+            s[f"lstm{l}_{j}"] = ParallelConfig((n,), devs)
+    for j in range(npc):
+        s[f"linear{j}"] = ParallelConfig((1, n), devs)
+        s[f"softmax{j}"] = ParallelConfig((n,), devs)
+    return s
+
+
+class RnnModel(FFModel):
+    def __init__(self, rnn_config: RnnConfig = None,
+                 machine: Optional[MachineModel] = None,
+                 strategies: Optional[Strategy] = None):
+        self.rnn = rnn_config or RnnConfig()
+        machine = machine or MachineModel()
+        if strategies is None:
+            strategies = default_global_config(self.rnn, machine)
+        ff_cfg = FFConfig(
+            batch_size=self.rnn.batch_size,
+            learning_rate=self.rnn.learning_rate,
+            weight_decay=0.0,
+            num_iterations=self.rnn.num_iterations,
+            compute_dtype=self.rnn.compute_dtype,
+            seed=self.rnn.seed,
+            strategies=strategies,
+        )
+        super().__init__(ff_cfg, machine)
+        self._build()
+
+    # ------------------------------------------------------------------
+
+    def _build(self):
+        cfg = self.rnn
+        npc = cfg.chunks_per_seq
+        L = cfg.lstm_per_node_length
+        B = cfg.batch_size
+
+        self.src_tokens = self.create_input((B, cfg.seq_length), "int32",
+                                            "src_tokens")
+        self.dst_tokens = self.create_input((B, cfg.seq_length), "int32",
+                                            "dst_tokens")
+
+        def pc(name, ndims):
+            return self._pc(name, ndims)
+
+        # chunk slices (reference: per-chunk word regions, nmt/rnn.cu:89-126)
+        srcs, dsts = [], []
+        for i in range(npc):
+            start = i * L
+            length = min(L, cfg.seq_length - start)
+            srcs.append(self._add(SliceSeq(
+                f"src_chunk{i}", pc(f"src_chunk{i}", 1), self.src_tokens,
+                start, length)))
+            dsts.append(self._add(SliceSeq(
+                f"dst_chunk{i}", pc(f"dst_chunk{i}", 1), self.dst_tokens,
+                start, length)))
+
+        # embeddings: chunks share srcEmbed / dstEmbed tables
+        embeds: List[Tensor] = []
+        for i in range(2 * npc):
+            tok = srcs[i] if i < npc else dsts[i - npc]
+            key = "srcEmbed" if i < npc else "dstEmbed"
+            embeds.append(self._add(Embed(
+                f"embed{i}", pc(f"embed{i}", 1), tok,
+                cfg.vocab_size, cfg.embed_size, param_key=key)))
+
+        # LSTM grid: lstm[layer][chunk] (nmt/rnn.cu:298-318)
+        lstm_out = [[None] * (2 * npc) for _ in range(cfg.num_layers)]
+        lstm_ops = [[None] * (2 * npc) for _ in range(cfg.num_layers)]
+        for i in range(cfg.num_layers):
+            for j in range(2 * npc):
+                x = embeds[j] if i == 0 else lstm_out[i - 1][j]
+                if j == 0:
+                    hx = cx = None  # zero initial state (zero[i], rnn.cu:127)
+                else:
+                    prev = lstm_ops[i][j - 1]
+                    hx, cx = prev.hy, prev.cy
+                key = f"encoder{i}" if j < npc else f"decoder{i}"
+                op = LSTMChunk(f"lstm{i}_{j}", pc(f"lstm{i}_{j}", 1),
+                               x, hx, cx, cfg.hidden_size, param_key=key)
+                self.layers.append(op)
+                lstm_ops[i][j] = op
+                lstm_out[i][j] = op.output
+
+        # vocab projection + per-chunk DP softmax loss (decoder side)
+        self.loss_ops = []
+        for j in range(npc):
+            logit = self._add(RnnLinear(
+                f"linear{j}", pc(f"linear{j}", 2),
+                lstm_out[cfg.num_layers - 1][npc + j],
+                cfg.vocab_size, param_key="linear"))
+            sm = SoftmaxDP(f"softmax{j}", pc(f"softmax{j}", 1),
+                           logit, dsts[j])
+            self.layers.append(sm)
+            self.loss_ops.append(sm)
+
+        for op in self.layers:
+            op.validate_partitioning()
+
+    # ------------------------------------------------------------------
+
+    def loss_fn(self, params, state, src, dst, train: bool = True):
+        """Mean NLL per target token over all decoder chunks."""
+        inputs = {self.src_tokens.tid: src, self.dst_tokens.tid: dst}
+        values, new_state = self.apply(params, state, inputs, train)
+        total = 0.0
+        for op in self.loss_ops:
+            total = total + op.loss(values[op.output.tid],
+                                    values[op.labels_tensor.tid])
+        ntokens = self.rnn.batch_size * self.rnn.seq_length
+        return total / ntokens, new_state
+
+    def make_train_step(self):
+        import jax
+
+        lr = self.rnn.learning_rate
+
+        def train_step(params, state, opt_state, src, dst):
+            def lf(p):
+                return self.loss_fn(p, state, src, dst, train=True)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            return new_params, new_state, opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data_iter, num_iterations: Optional[int] = None,
+            warmup: int = 1, log=print):
+        """Timed loop with the reference's print format
+        (nmt/nmt.cc:70-83: seconds per chunk of iterations)."""
+        num_iterations = num_iterations or self.rnn.num_iterations
+        warmup = min(warmup, max(num_iterations - 1, 0))
+        params, state = self.init()
+        step = self.make_train_step()
+        losses = []
+        start = time.perf_counter()
+        loss = None
+        for it in range(num_iterations):
+            src, dst = next(data_iter)
+            if it == warmup:
+                if loss is not None:
+                    float(loss)
+                start = time.perf_counter()
+            params, state, _, loss = step(params, state, None, src, dst)
+            losses.append(loss)
+        if loss is not None:
+            float(loss)
+        elapsed = time.perf_counter() - start
+        n_timed = num_iterations - warmup
+        log(f"time = {elapsed:.4f}s")
+        tput = (n_timed * self.rnn.batch_size / elapsed
+                if elapsed > 0 and n_timed > 0 else 0.0)
+        return {"params": params, "state": state,
+                "loss": [float(l) for l in losses],
+                "elapsed_s": elapsed, "sentences_per_sec": tput}
+
+
+def synthetic_token_batches(machine: MachineModel, batch_size: int,
+                            seq_length: int, vocab_size: int, seed: int = 0):
+    """Random token pairs, batch-sharded (reference inits word tensors with
+    a constant; random avoids degenerate instant memorization)."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    n = machine.num_devices
+    sh = machine.sharding(ParallelConfig((n,), tuple(range(n))), ("n",),
+                          P("n"))
+    rng = np.random.RandomState(seed)
+    while True:
+        src = rng.randint(0, vocab_size, (batch_size, seq_length)).astype("int32")
+        dst = rng.randint(0, vocab_size, (batch_size, seq_length)).astype("int32")
+        yield jax.device_put(src, sh), jax.device_put(dst, sh)

@@ -70,6 +70,12 @@ class Op:
         self.pc = pc
         self.inputs: List[Tensor] = list(inputs)
         self.output: Tensor = None  # set by subclass
+        #: extra outputs (e.g. LSTM hy/cy); forward then returns a tuple
+        self.outputs: List[Tensor] = None
+        #: params-dict key; ops sharing a key share weights (the reference's
+        #: SharedVariable across chunk ops, nmt/rnn.h:37-51) — the first op
+        #: with a key initializes, gradients sum automatically in jax.grad
+        self.param_key: str = name
 
     # ---- parameters ----------------------------------------------------
 
@@ -94,6 +100,10 @@ class Op:
         """PartitionSpec of the output over AXIS_NAMES."""
         raise NotImplementedError
 
+    def output_specs(self) -> List:
+        """One spec per output (multi-output ops override)."""
+        return [self.output_spec()]
+
     def param_specs(self) -> Dict:
         """PartitionSpec per param leaf (same tree structure as
         init_params)."""
@@ -102,6 +112,28 @@ class Op:
     def output_sharding(self, machine):
         return machine.sharding(self.pc, self.AXIS_NAMES, self.output_spec())
 
+    def validate_partitioning(self):
+        """Grid dims must divide the tensor dims they partition — the
+        equivalent of the reference's disjoint/complete partition asserts
+        (conv_2d.cu:108-109)."""
+        sizes = dict(zip(self.AXIS_NAMES, self.pc.dims))
+        outs = self.outputs if self.outputs else [self.output]
+        for t, spec in zip(outs, self.output_specs()):
+            if spec is None:
+                continue
+            for d, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                parts = 1
+                for a in axes:
+                    parts *= sizes.get(a, 1)
+                if t.shape[d] % parts:
+                    raise ValueError(
+                        f"op {self.name!r}: output dim {d} of size "
+                        f"{t.shape[d]} not divisible by its partition "
+                        f"count {parts} (grid {self.pc.dims})")
+
     def param_shardings(self, machine) -> Dict:
         """Shardings for placing params as jit inputs (canonical device
         assignment; see MachineModel.input_sharding)."""
@@ -109,6 +141,13 @@ class Op:
             k: machine.input_sharding(self.pc, self.AXIS_NAMES, spec)
             for k, spec in self.param_specs().items()
         }
+
+    def local_clone(self, pc: ParallelConfig):
+        """A new op instance at *shard-local* shapes under ``pc`` — what one
+        device computes.  Used by MeasuredCostModel to time real shard work
+        (the reference measures each partition count the same way,
+        scripts/cnn.h).  None -> analytic fallback."""
+        return None
 
     # ---- cost model hooks (consumed by the simulator) ------------------
 

@@ -85,6 +85,17 @@ class Conv2D(Op):
             y = jax.nn.relu(y)
         return y, state
 
+    def local_clone(self, pc: ParallelConfig):
+        pw, ph, pc_, pn = pc.dims
+        n, h, w, cin = self.inputs[0].shape
+        if n % pn or h % ph or w % pw or self.out_channels % pc_:
+            return None
+        t = Tensor((n // pn, h // ph, w // pw, cin))
+        return Conv2D(self.name, ParallelConfig((1, 1, 1, 1), (0,)), t,
+                      self.out_channels // pc_, self.kernel_h, self.kernel_w,
+                      self.stride_h, self.stride_w, self.padding_h,
+                      self.padding_w, self.relu)
+
     def flops_per_sample(self) -> float:
         _, oh, ow, oc = self.output.shape
         return 2.0 * oh * ow * oc * self.kernel_h * self.kernel_w * self.in_channels

@@ -1,0 +1,45 @@
+"""Elementwise Add (residual connections).
+
+The reference has NO elementwise op — its "ResNet-101" BottleneckBlock is a
+plain conv stack with the residual adds absent (inception.h:122-132, bn
+layers commented out).  We mirror that topology for parity, but also provide
+this op so true residual networks are expressible — a capability extension,
+not a port."""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class Add(Op):
+    AXIS_NAMES = ("w", "h", "c", "n")
+
+    def __init__(self, name: str, pc: ParallelConfig, inputs: List[Tensor],
+                 relu: bool = False):
+        super().__init__(name, pc, inputs)
+        assert len(inputs) == 2
+        assert inputs[0].shape == inputs[1].shape, (
+            f"add inputs must match: {inputs[0].shape} vs {inputs[1].shape}")
+        self.relu = relu
+        self.output = Tensor(inputs[0].shape, inputs[0].dtype, self, name)
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", "h", "w", "c")
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax
+
+        y = xs[0] + xs[1]
+        if self.relu:
+            y = jax.nn.relu(y)
+        return y, state
+
+    def flops_per_sample(self) -> float:
+        import math
+
+        return float(math.prod(self.output.shape[1:]))

@@ -1,0 +1,58 @@
+"""Embedding (reference: nmt/embed.cu — custom gather forward kernel
+:151-165, scatter-add backward via atomicAdd :167-180).
+
+TPU-native: ``jnp.take`` on the table; the scatter-add backward is jax's
+gather VJP.  1-D grid over batch.  The reference requires power-of-2
+output_size (shift arithmetic in its kernels) — no such restriction here.
+Chunk ops share one table via param_key (srcEmbed/dstEmbed SharedVariables,
+nmt/rnn.cu:159-194)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class Embed(Op):
+    AXIS_NAMES = ("n",)
+
+    def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
+                 vocab_size: int, embed_size: int,
+                 param_key: str = None):
+        super().__init__(name, pc, [input])
+        assert input.ndim == 2, "embed input must be (batch, length) int ids"
+        self.vocab_size = vocab_size
+        self.embed_size = embed_size
+        if param_key:
+            self.param_key = param_key
+        n, length = input.shape
+        self.output = Tensor((n, length, embed_size), "float32", self, name)
+
+    def init_params(self, rng) -> Dict:
+        import jax
+
+        # normal(0.01) like reference's rnn_randomize (uniform small init)
+        table = jax.random.normal(
+            rng, (self.vocab_size, self.embed_size), "float32") * 0.05
+        return {"table": table}
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return {"table": P(None, None)}
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", None, None)
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax.numpy as jnp
+
+        (ids,) = xs
+        return jnp.take(params["table"], ids, axis=0), state
+
+    def param_bytes(self) -> int:
+        return 4 * self.vocab_size * self.embed_size

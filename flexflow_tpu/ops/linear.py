@@ -64,6 +64,15 @@ class Linear(Op):
             y = jax.nn.relu(y)
         return y, state
 
+    def local_clone(self, pc: ParallelConfig):
+        pc_, pn = pc.dims
+        n, d = self.inputs[0].shape
+        if n % pn or self.out_channels % pc_:
+            return None
+        t = Tensor((n // pn, d))
+        return Linear(self.name, ParallelConfig((1, 1), (0,)), t,
+                      self.out_channels // pc_, self.relu)
+
     def flops_per_sample(self) -> float:
         return 2.0 * self.in_channels * self.out_channels
 

@@ -1,0 +1,132 @@
+"""Chunked LSTM (reference: nmt/lstm.cu — one op = 1 layer x
+LSTM_PER_NODE_LENGTH timesteps x batch-shard, executed by
+cudnnRNNForwardTraining/Backward on the chunk, nmt/lstm.cu:323, 489-498).
+
+TPU-native: ``lax.scan`` over the chunk's timesteps; the two gate matmuls
+are batched MXU GEMMs.  Inputs (x, hx, cx), outputs (y, hy, cy) exactly as
+the reference (nmt/lstm.cu:137-144); hidden state flows to the next chunk op
+as a plain tensor dependency, giving the same wavefront/pipeline execution
+across chunks placed on different devices (SURVEY.md §2.6 PP).  All chunk
+ops of one layer share weights via param_key (SharedVariable encoders[i]/
+decoders[i], nmt/rnn.cu:196-233)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class LSTMChunk(Op):
+    AXIS_NAMES = ("n",)
+
+    def __init__(self, name: str, pc: ParallelConfig, x: Tensor,
+                 hx: Tensor, cx: Tensor, hidden_size: int,
+                 param_key: str = None):
+        inputs = [x] + ([hx, cx] if hx is not None else [])
+        super().__init__(name, pc, inputs)
+        assert x.ndim == 3, "lstm x must be (batch, chunk_len, input_size)"
+        n, length, in_size = x.shape
+        self.has_initial_state = hx is not None
+        self.input_size = in_size
+        self.hidden_size = hidden_size
+        if param_key:
+            self.param_key = param_key
+        self.output = Tensor((n, length, hidden_size), "float32", self,
+                             f"{name}.y")
+        self.hy = Tensor((n, hidden_size), "float32", self, f"{name}.hy")
+        self.cy = Tensor((n, hidden_size), "float32", self, f"{name}.cy")
+        self.outputs = [self.output, self.hy, self.cy]
+
+    def init_params(self, rng) -> Dict:
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2 = jax.random.split(rng)
+        h = self.hidden_size
+        w_ih = jax.nn.initializers.glorot_uniform()(
+            k1, (self.input_size, 4 * h), "float32")
+        w_hh = jax.nn.initializers.orthogonal()(
+            k2, (h, 4 * h), "float32")
+        # forget-gate bias 1.0 (gate order: i, f, g, o)
+        b = jnp.zeros((4 * h,), "float32").at[h:2 * h].set(1.0)
+        return {"w_ih": w_ih, "w_hh": w_hh, "b": b}
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return {"w_ih": P(None, None), "w_hh": P(None, None), "b": P(None)}
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", None, None)
+
+    def output_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None, None), P("n", None), P("n", None)]
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        x = xs[0]
+        n = x.shape[0]
+        h = self.hidden_size
+        if self.has_initial_state:
+            hx, cx = xs[1], xs[2]
+        else:
+            hx = jnp.zeros((n, h), x.dtype)
+            cx = jnp.zeros((n, h), x.dtype)
+        w_ih = params["w_ih"].astype(x.dtype)
+        w_hh = params["w_hh"].astype(x.dtype)
+        b = params["b"].astype(x.dtype)
+
+        # hoist the input projection out of the scan: one big MXU GEMM
+        # (B, L, E) @ (E, 4H) for the whole chunk
+        xg = jnp.einsum("ble,eg->blg", x, w_ih,
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+
+        def step(carry, xg_t):
+            h_t, c_t = carry
+            gates = xg_t + jnp.dot(h_t, w_hh,
+                                   preferred_element_type=jnp.float32
+                                   ).astype(x.dtype) + b
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c = f * c_t + i * g
+            y = o * jnp.tanh(c)
+            return (y, c), y
+
+        (hy, cy), ys = lax.scan(step, (hx, cx),
+                                jnp.swapaxes(xg, 0, 1))  # (L, B, 4H)
+        y = jnp.swapaxes(ys, 0, 1)  # (B, L, H)
+        return (y, hy, cy), state
+
+    def local_clone(self, pc: ParallelConfig):
+        (pn,) = pc.dims
+        n, length, e = self.inputs[0].shape
+        if n % pn:
+            return None
+        x = Tensor((n // pn, length, e))
+        hx = Tensor((n // pn, self.hidden_size)) \
+            if self.has_initial_state else None
+        cx = Tensor((n // pn, self.hidden_size)) \
+            if self.has_initial_state else None
+        return LSTMChunk(self.name, ParallelConfig((1,), (0,)), x, hx, cx,
+                         self.hidden_size)
+
+    def flops_per_sample(self) -> float:
+        length = self.output.shape[1]
+        return 2.0 * length * 4 * self.hidden_size * (
+            self.input_size + self.hidden_size)
+
+    def param_bytes(self) -> int:
+        h = self.hidden_size
+        return 4 * (self.input_size * 4 * h + h * 4 * h + 4 * h)

@@ -75,6 +75,15 @@ class BatchNorm(Op):
             y = jax.nn.relu(y)
         return y, state
 
+    def local_clone(self, pc: ParallelConfig):
+        pw, ph, pc_, pn = pc.dims
+        n, h, w, c = self.inputs[0].shape
+        if n % pn or h % ph or w % pw or c % pc_:
+            return None
+        t = Tensor((n // pn, h // ph, w // pw, c // pc_))
+        return BatchNorm(self.name, ParallelConfig((1, 1, 1, 1), (0,)), t,
+                         self.relu, self.eps, self.momentum)
+
     def flops_per_sample(self) -> float:
         _, h, w, c = self.output.shape
         return 8.0 * h * w * c

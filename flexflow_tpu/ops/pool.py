@@ -61,6 +61,17 @@ class Pool2D(Op):
             y = jax.nn.relu(y)
         return y, state
 
+    def local_clone(self, pc: ParallelConfig):
+        pw, ph, pc_, pn = pc.dims
+        n, h, w, c = self.inputs[0].shape
+        if n % pn or h % ph or w % pw or c % pc_:
+            return None
+        t = Tensor((n // pn, h // ph, w // pw, c // pc_))
+        return Pool2D(self.name, ParallelConfig((1, 1, 1, 1), (0,)), t,
+                      self.kernel_h, self.kernel_w, self.stride_h,
+                      self.stride_w, self.padding_h, self.padding_w,
+                      self.pool_type, self.relu)
+
     def flops_per_sample(self) -> float:
         _, oh, ow, c = self.output.shape
         return float(oh * ow * c * self.kernel_h * self.kernel_w)

@@ -1,0 +1,41 @@
+"""Sequence chunking: static slice of the token tensor into
+LSTM_PER_NODE_LENGTH-step chunks.
+
+The reference materializes a separate Legion region per chunk
+(nmt/rnn.cu:89-126 src/dst word tensors); here chunks are static slices of
+one (batch, seq_len) input inside the jit program — each chunk Tensor is
+independently placeable, which is what makes per-chunk device placement
+(pipeline-style operator parallelism) expressible."""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class SliceSeq(Op):
+    AXIS_NAMES = ("n",)
+
+    def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
+                 start: int, length: int):
+        super().__init__(name, pc, [input])
+        assert input.ndim == 2
+        n, total = input.shape
+        assert start + length <= total
+        self.start = start
+        self.length = length
+        self.output = Tensor((n, length), input.dtype, self, name)
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", None)
+
+    def forward(self, params, state, xs: List, train: bool):
+        from jax import lax
+
+        (x,) = xs
+        return lax.slice_in_dim(x, self.start, self.start + self.length,
+                                axis=1), state

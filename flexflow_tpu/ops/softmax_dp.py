@@ -1,0 +1,47 @@
+"""Data-parallel softmax + CE for RNN chunks (reference:
+nmt/softmax_data_parallel.cu — explicitly repartitions vocab-sharded logits
+to batch-only sharding :85-100, then cudnnSoftmaxForward + fused CE backward
+:198-310).
+
+Here the repartition is the output sharding constraint (P over 'n' only);
+GSPMD converts the producer's vocab-sharded layout.  Labels are a graph
+input (the same chunk's dst tokens — reference parity: predicts the current
+token, nmt/rnn.cu:330-333, no shift).  ``loss`` returns the SUM of NLL over
+the chunk; the RnnModel normalizes by total target tokens."""
+
+from __future__ import annotations
+
+from typing import List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class SoftmaxDP(Op):
+    AXIS_NAMES = ("n",)
+    is_loss = True
+
+    def __init__(self, name: str, pc: ParallelConfig, logits: Tensor,
+                 labels: Tensor):
+        super().__init__(name, pc, [logits, labels])
+        assert logits.ndim == 3 and labels.ndim == 2
+        assert logits.shape[:2] == labels.shape
+        self.labels_tensor = labels
+        self.output = Tensor(logits.shape, "float32", self, name)
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", None, None)
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax
+
+        logits, _ = xs
+        return jax.nn.log_softmax(logits.astype("float32"), axis=-1), state
+
+    def loss(self, log_probs, labels):
+        import jax.numpy as jnp
+
+        nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)
+        return jnp.sum(nll)

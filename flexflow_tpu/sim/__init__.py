@@ -1,0 +1,9 @@
+"""Strategy-search subsystem: execution simulator + MCMC search
+(reference: scripts/simulator.cc + scripts/cnn.h measure_* harness),
+re-designed for TPU: analytic MXU/HBM roofline or measured-on-chip cost
+tables, ICI/DCN two-tier communication model, native C++ hot loop."""
+
+from flexflow_tpu.sim.cost_model import AnalyticCostModel, MeasuredCostModel
+from flexflow_tpu.sim.search import StrategySearch
+
+__all__ = ["AnalyticCostModel", "MeasuredCostModel", "StrategySearch"]

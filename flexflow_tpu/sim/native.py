@@ -1,0 +1,90 @@
+"""ctypes bindings for the native simulator (native/simulator.cc).
+
+Builds libffsim.so on demand with the in-tree Makefile (g++ is part of the
+baked toolchain)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Sequence
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libffsim.so")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    # unconditional make: no-op when up to date, rebuilds on simulator.cc
+    # edits (the .so is not committed)
+    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                   capture_output=True)
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.ffsim_create.restype = ctypes.c_void_p
+    lib.ffsim_create.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+    lib.ffsim_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffsim_simulate.restype = ctypes.c_double
+    lib.ffsim_simulate.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int32)]
+    lib.ffsim_mcmc.restype = ctypes.c_double
+    lib.ffsim_mcmc.argtypes = [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_int32),
+                               ctypes.c_int64, ctypes.c_double,
+                               ctypes.c_uint64]
+    _lib = lib
+    return lib
+
+
+class NativeSimulator:
+    """Owns one ffsim instance built from serialized buffers."""
+
+    def __init__(self, ints: Sequence[int], dbls: Sequence[float],
+                 n_ops: int):
+        lib = _load()
+        self._ints = np.ascontiguousarray(ints, dtype=np.int64)
+        self._dbls = np.ascontiguousarray(dbls, dtype=np.float64)
+        self.n_ops = n_ops
+        self._handle = lib.ffsim_create(
+            self._ints.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(self._ints),
+            self._dbls.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(self._dbls))
+        if not self._handle:
+            raise RuntimeError("ffsim_create failed")
+
+    def simulate(self, assignment: Sequence[int]) -> float:
+        lib = _load()
+        a = np.ascontiguousarray(assignment, dtype=np.int32)
+        assert len(a) == self.n_ops
+        return lib.ffsim_simulate(
+            self._handle, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+
+    def mcmc(self, assignment: Sequence[int], iters: int = 250_000,
+             beta: float = 5e3, seed: int = 0):
+        """Returns (best_assignment, best_time). beta is per-second cost
+        delta (the reference uses exp(-5 * delta_ms), i.e. 5e3 / s)."""
+        lib = _load()
+        a = np.ascontiguousarray(assignment, dtype=np.int32).copy()
+        assert len(a) == self.n_ops
+        t = lib.ffsim_mcmc(
+            self._handle, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            iters, beta, seed)
+        return a.tolist(), t
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            try:
+                _load().ffsim_destroy(self._handle)
+            except Exception:
+                pass
+            self._handle = None

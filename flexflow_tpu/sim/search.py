@@ -1,0 +1,328 @@
+"""Strategy search driver: candidate generation, shard geometry, native-sim
+serialization, MCMC, and the closed loop back to an executable Strategy
+(closing the gap SURVEY.md §2.5 notes: the reference has no automated
+simulator -> strategy-file writer).
+
+Geometry: for every (op, candidate config) we emit, per grid point, the
+device plus the output tile rectangle and the input footprint rectangles in
+each producer's coordinate space — the information Legion derives from
+region trees (conv_2d.cu partitions) and the reference simulator recomputes
+in get_tensor_shape/intersect (scripts/simulator.cc:886-959).  The native
+library intersects producer tiles with consumer footprints to derive
+communication, exactly like Legion derives copies."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.sim.cost_model import AnalyticCostModel
+from flexflow_tpu.sim.native import NativeSimulator
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+FULL = None  # marker: whole extent
+
+
+def _split(extent: int, parts: int, idx: int) -> Tuple[int, int]:
+    base = extent // parts
+    return idx * base, (idx + 1) * base if idx + 1 < parts else extent
+
+
+def _rect(*pairs) -> List[int]:
+    out = []
+    for p in pairs:
+        out.extend(p)
+    while len(out) < 8:
+        out.extend((0, 1))
+    return out
+
+
+def op_geometry(op: Op, pc: ParallelConfig):
+    """[(device, out_rect, [in_rects...])] for each grid point (dim0
+    fastest, matching ParallelConfig.devices linearization)."""
+    kind = type(op).__name__
+    dims = pc.dims
+    pts = []
+    for lin in range(pc.num_parts):
+        idx = []
+        rem = lin
+        for d in dims:
+            idx.append(rem % d)
+            rem //= d
+        dev = pc.devices[lin]
+        out_rect, in_rects = _point_geometry(op, kind, dims, idx)
+        pts.append((dev, out_rect, in_rects))
+    return pts
+
+
+def _point_geometry(op: Op, kind: str, dims, idx):
+    i0 = op.inputs[0] if op.inputs else None
+    if kind in ("Conv2D", "Pool2D", "BatchNorm", "Add", "Concat"):
+        pw, ph, pcc, pn = dims
+        iw, ih, ic, in_ = idx
+        n, oh, ow, oc = op.output.shape
+        out = _rect(_split(n, pn, in_), _split(oh, ph, ih),
+                    _split(ow, pw, iw), _split(oc, pcc, ic))
+        ins = []
+        for i, t in enumerate(op.inputs):
+            tn, th, tw, tc = t.shape
+            if kind in ("BatchNorm", "Add"):
+                cr = _split(tc, pcc, ic)
+            else:  # conv/pool read all input channels; concat reads each
+                   # input's full channel range (its slice of the output)
+                cr = (0, tc)
+            ins.append(_rect(_split(tn, pn, in_), _split(th, ph, ih),
+                             _split(tw, pw, iw), cr))
+        return out, ins
+    if kind == "Flat":
+        pcc, pn = dims
+        ic, in_ = idx
+        n, d = op.output.shape
+        out = _rect(_split(n, pn, in_), (0, d))
+        tn, th, tw, tc = i0.shape
+        return out, [_rect(_split(tn, pn, in_), (0, th), (0, tw), (0, tc))]
+    if kind in ("Linear",):
+        pcc, pn = dims
+        ic, in_ = idx
+        n, c = op.output.shape
+        out = _rect(_split(n, pn, in_), _split(c, pcc, ic))
+        tn, td = i0.shape
+        return out, [_rect(_split(tn, pn, in_), (0, td))]
+    if kind == "RnnLinear":
+        pcc, pn = dims
+        ic, in_ = idx
+        n, l, v = op.output.shape
+        out = _rect(_split(n, pn, in_), (0, l), _split(v, pcc, ic))
+        tn, tl, td = i0.shape
+        return out, [_rect(_split(tn, pn, in_), (0, tl), (0, td))]
+    if kind == "Softmax":
+        (pn,) = dims
+        (in_,) = idx
+        n, c = op.output.shape
+        out = _rect(_split(n, pn, in_), (0, c))
+        return out, [_rect(_split(n, pn, in_), (0, c))]
+    if kind == "SoftmaxDP":
+        (pn,) = dims
+        (in_,) = idx
+        n, l, v = op.output.shape
+        out = _rect(_split(n, pn, in_), (0, l), (0, v))
+        labels = op.inputs[1]
+        return out, [
+            _rect(_split(n, pn, in_), (0, l), (0, v)),
+            _rect(_split(labels.shape[0], pn, in_), (0, labels.shape[1])),
+        ]
+    if kind == "SliceSeq":
+        (pn,) = dims
+        (in_,) = idx
+        n, l = op.output.shape
+        out = _rect(_split(n, pn, in_), (0, l))
+        return out, [_rect(_split(n, pn, in_),
+                           (op.start, op.start + op.length))]
+    if kind == "Embed":
+        (pn,) = dims
+        (in_,) = idx
+        n, l, e = op.output.shape
+        out = _rect(_split(n, pn, in_), (0, l), (0, e))
+        return out, [_rect(_split(n, pn, in_), (0, l))]
+    if kind == "LSTMChunk":
+        (pn,) = dims
+        (in_,) = idx
+        n, l, h = op.output.shape
+        out = _rect(_split(n, pn, in_), (0, l), (0, h))
+        ins = []
+        x = op.inputs[0]
+        ins.append(_rect(_split(x.shape[0], pn, in_), (0, x.shape[1]),
+                         (0, x.shape[2])))
+        # hx/cx: footprint in the producer LSTM's y-space = its last step
+        for t in op.inputs[1:]:
+            prod = t.producer
+            lp = prod.output.shape[1]
+            ins.append(_rect(_split(t.shape[0], pn, in_), (lp - 1, lp),
+                             (0, t.shape[1])))
+        return out, ins
+    raise NotImplementedError(f"no geometry for op kind {kind}")
+
+
+def _axis_extents(op: Op) -> Dict[str, List[int]]:
+    """Per grid axis, the tensor extents it must divide."""
+    kind = type(op).__name__
+    if kind in ("Conv2D", "Pool2D", "BatchNorm", "Add", "Concat"):
+        n, oh, ow, oc = op.output.shape
+        in_, ih, iw, ic = op.inputs[0].shape
+        ext = {"w": [ow, iw], "h": [oh, ih], "c": [oc], "n": [n]}
+        if kind in ("BatchNorm", "Add"):
+            ext["c"].append(ic)
+        return ext
+    if kind in ("Linear",):
+        n, c = op.output.shape
+        return {"c": [c], "n": [n]}
+    if kind == "Flat":
+        return {"c": [1], "n": [op.output.shape[0]]}
+    if kind == "RnnLinear":
+        n, _, v = op.output.shape
+        return {"c": [v], "n": [n]}
+    return {"n": [op.output.shape[0]]}
+
+
+def candidate_configs(op: Op, num_devices: int,
+                      max_per_axis: Optional[Dict[str, int]] = None
+                      ) -> List[ParallelConfig]:
+    """Power-of-2 grids (the reference constrains the search the same way,
+    scripts/simulator.cc:143-151) whose product divides the machine and
+    whose dims divide the tensor extents they partition."""
+    ext = _axis_extents(op)
+    axes = op.AXIS_NAMES
+    choices_per_axis = []
+    for a in axes:
+        limit = num_devices
+        if max_per_axis and a in max_per_axis:
+            limit = min(limit, max_per_axis[a])
+        opts = []
+        p = 1
+        while p <= limit:
+            if all(e % p == 0 for e in ext.get(a, [1])):
+                opts.append(p)
+            p *= 2
+        choices_per_axis.append(opts or [1])
+    out = []
+    def rec(i, dims, prod):
+        if prod > num_devices or num_devices % prod and i == len(axes):
+            return
+        if i == len(axes):
+            if num_devices % prod == 0:
+                out.append(ParallelConfig(tuple(dims),
+                                          tuple(range(prod))))
+            return
+        for c in choices_per_axis[i]:
+            if prod * c <= num_devices:
+                rec(i + 1, dims + [c], prod * c)
+    rec(0, [], 1)
+    # dedupe + keep deterministic order; ensure pure-DP present
+    uniq = {}
+    for pc in out:
+        uniq[pc.dims] = pc
+    return list(uniq.values())
+
+
+class StrategySearch:
+    """Closed loop: model -> candidates -> cost tables -> native sim ->
+    MCMC -> Strategy (executable + serializable)."""
+
+    def __init__(self, model: FFModel, machine: Optional[MachineModel] = None,
+                 cost_model=None,
+                 max_per_axis: Optional[Dict[str, int]] = None):
+        self.model = model
+        self.machine = machine or model.machine
+        self.cost_model = cost_model or AnalyticCostModel()
+        self.max_per_axis = max_per_axis
+        self.ops: List[Op] = list(model.layers)
+        self._op_index = {}
+        for i, op in enumerate(self.ops):
+            for t in (op.outputs or [op.output]):
+                self._op_index[t.tid] = i
+        self.candidates: List[List[ParallelConfig]] = []
+        self.sim: Optional[NativeSimulator] = None
+        self._build()
+
+    def _build(self):
+        n_dev = self.machine.num_devices
+        topo = self.machine.topology
+        ints: List[int] = [n_dev, topo.devices_per_ici_group, len(self.ops)]
+        costs: List[float] = []
+        replicas: List[float] = []
+        pbytes: List[float] = []
+        seen_param_keys = set()
+        for op in self.ops:
+            cands = candidate_configs(op, n_dev, self.max_per_axis)
+            self.candidates.append(cands)
+            producers = [self._op_index.get(t.tid, -1) for t in op.inputs]
+            ints.append(len(producers))
+            ints.extend(producers)
+            ints.append(len(cands))
+            for pc in cands:
+                pts = op_geometry(op, pc)
+                ints.append(len(pts))
+                for dev, out_rect, in_rects in pts:
+                    ints.append(dev)
+                    ints.extend(out_rect)
+                    assert len(in_rects) == len(producers)
+                    for r in in_rects:
+                        ints.extend(r)
+                costs.append(self.cost_model.op_cost(op, pc))
+                replicas.append(self._param_replicas(op, pc))
+            # shared weights (param_key) are synced once per step, not once
+            # per chunk op — charge the first op carrying the key
+            if op.param_key in seen_param_keys:
+                pbytes.append(0.0)
+            else:
+                seen_param_keys.add(op.param_key)
+                pbytes.append(float(op.param_bytes()))
+        dbls = [topo.ici_bandwidth, topo.dcn_bandwidth, topo.ici_latency]
+        dbls.extend(pbytes)
+        dbls.extend(costs)
+        dbls.extend(replicas)
+        self.sim = NativeSimulator(ints, dbls, len(self.ops))
+
+    @staticmethod
+    def _param_replicas(op: Op, pc: ParallelConfig) -> float:
+        specs = op.param_specs()
+        if not specs:
+            return 1.0
+        shard_axes = set()
+        for spec in specs.values():
+            for entry in spec:
+                if entry is None:
+                    continue
+                for a in (entry if isinstance(entry, tuple) else (entry,)):
+                    shard_axes.add(a)
+        sizes = dict(zip(op.AXIS_NAMES, pc.dims))
+        shard = 1
+        for a in shard_axes:
+            shard *= sizes.get(a, 1)
+        return pc.num_parts / shard
+
+    # ------------------------------------------------------------------
+
+    def dp_assignment(self) -> List[int]:
+        """Index of the pure-DP candidate per op (batch split over all
+        devices; falls back to the largest batch-only split available)."""
+        out = []
+        for op, cands in zip(self.ops, self.candidates):
+            best, best_n = 0, -1
+            for i, pc in enumerate(cands):
+                batch_parts = pc.dims[-1]
+                others = pc.num_parts // batch_parts
+                if others == 1 and batch_parts > best_n:
+                    best, best_n = i, batch_parts
+            out.append(best)
+        return out
+
+    def assignment_to_strategy(self, assignment: Sequence[int]) -> Strategy:
+        s = Strategy()
+        for op, cands, idx in zip(self.ops, self.candidates, assignment):
+            s[op.name] = cands[idx]
+        return s
+
+    def simulate(self, assignment: Sequence[int]) -> float:
+        return self.sim.simulate(assignment)
+
+    def search(self, iters: int = 250_000, beta: float = 5e3,
+               seed: int = 0):
+        """MCMC from the DP start point (reference: scripts/simulator.cc
+        :1427-1471). Returns (strategy, info)."""
+        dp = self.dp_assignment()
+        dp_time = self.sim.simulate(dp)
+        best, best_time = self.sim.mcmc(dp, iters=iters, beta=beta,
+                                        seed=seed)
+        info = {
+            "dp_time": dp_time,
+            "best_time": best_time,
+            "speedup_vs_dp": dp_time / best_time if best_time else 1.0,
+            "assignment": best,
+        }
+        return self.assignment_to_strategy(best), info

@@ -1,0 +1,159 @@
+"""Model zoo: shape checks on full 224/299 builds, and one train step on
+small variants for graph correctness (Inception needs multi-input concat
+plumbing; DenseNet exercises BN + concat chains; ResNet both modes)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.data import synthetic_batches
+from flexflow_tpu.models import (build_alexnet, build_densenet121,
+                                 build_inception_v3, build_resnet101,
+                                 build_vgg16)
+
+
+def cfg(h=224, w=224, b=2, classes=1000):
+    return FFConfig(batch_size=b, input_height=h, input_width=w,
+                    print_freq=0, num_classes=classes)
+
+
+def test_vgg16_shapes(machine1):
+    ff = build_vgg16(cfg(), machine1)
+    conv_count = sum(1 for op in ff.layers if type(op).__name__ == "Conv2D")
+    assert conv_count == 13
+    flat = [op for op in ff.layers if op.name == "flat"][0]
+    assert flat.output.shape == (2, 7 * 7 * 512)
+    assert ff.layers[-1].output.shape == (2, 1000)
+
+
+def test_inception_v3_shapes(machine1):
+    ff = build_inception_v3(cfg(h=299, w=299), machine1)
+    by_name = {op.name: op for op in ff.layers}
+    # block output channels (torchvision Inception3 parity)
+    assert by_name["incA1_concat"].output.shape[3] == 256
+    assert by_name["incA2_concat"].output.shape[3] == 288
+    assert by_name["incB1_concat"].output.shape[3] == 768
+    assert by_name["incC1_concat"].output.shape[3] == 768
+    assert by_name["incD1_concat"].output.shape[3] == 1280
+    assert by_name["incE1_concat"].output.shape[3] == 2048
+    # final avgpool over exactly 8x8
+    assert by_name["pool3"].inputs[0].shape[1:3] == (8, 8)
+    assert by_name["pool3"].output.shape == (2, 1, 1, 2048)
+
+
+def test_resnet101_shapes(machine1):
+    ff = build_resnet101(cfg(), machine1)
+    # 1 stem + 3*(3) + 4*3 + 23*3 + 3*3 bottleneck convs + linear
+    conv_count = sum(1 for op in ff.layers if type(op).__name__ == "Conv2D")
+    assert conv_count == 1 + 3 * (3 + 4 + 23 + 3)
+    by_name = {op.name: op for op in ff.layers}
+    assert by_name["pool2"].output.shape == (2, 1, 1, 2048)
+
+    ffr = build_resnet101(cfg(), machine1, residual=True)
+    adds = [op for op in ffr.layers if type(op).__name__ == "Add"]
+    assert len(adds) == 3 + 4 + 23 + 3
+
+
+def test_densenet121_shapes(machine1):
+    ff = build_densenet121(cfg(), machine1)
+    by_name = {op.name: op for op in ff.layers}
+    assert by_name["dense1_l5_concat"].output.shape[3] == 64 + 6 * 32
+    assert by_name["trans1_conv"].output.shape[3] == 128
+    # final block: 512 + 16*32 = 1024 channels at 7x7
+    assert by_name["pool2"].inputs[0].shape == (2, 7, 7, 1024)
+
+
+def test_inception_block_train_step(machine8):
+    """One real train step through a 4-branch InceptionA block (multi-input
+    concat + avg-pool branch) under a hybrid strategy."""
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.models.inception import inception_a
+    from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+    c = cfg(h=16, w=16, b=8, classes=10)
+    c.strategies = Strategy({
+        "incA_b2_5x5": ParallelConfig((1, 1, 2, 4), tuple(range(8))),
+        "incA_concat": ParallelConfig((1, 2, 1, 4), tuple(range(8))),
+    })
+    ff = FFModel(c, machine8)
+    img = ff.create_input((8, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 16, 3, 3, 1, 1, 1, 1, relu=True)
+    t = inception_a(ff, "incA", t, 8)
+    assert t.shape[3] == 64 + 64 + 96 + 8
+    t = ff.pool2d("gap", t, 16, 16, 1, 1, 0, 0, pool_type="avg", relu=False)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 10, relu=False)
+    ff.softmax("softmax", t)
+
+    params, state = ff.init()
+    opt = ff.init_opt_state(params)
+    step = ff.make_train_step()
+    data = synthetic_batches(machine8, 8, 16, 16, num_classes=10,
+                             mode="random")
+    img_, lbl = next(data)
+    params, state, opt, loss = step(params, state, opt, img_, lbl)
+    assert np.isfinite(float(loss))
+
+
+def test_densenet_small_train_step(machine8):
+    """One real train step through BN+concat chains on a downsized
+    DenseNet-style net (full 121 layers on CPU is slow)."""
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.models.densenet import dense_block, transition
+
+    c = cfg(h=32, w=32, b=8, classes=10)
+    ff = FFModel(c, machine8)
+    img = ff.create_input((8, 32, 32, 3), name="image")
+    t = ff.conv2d("conv1", img, 16, 3, 3, 1, 1, 1, 1, relu=False)
+    t = ff.batch_norm("bn1", t, relu=True)
+    t = dense_block(ff, "d1", t, 3, 8)
+    t = transition(ff, "t1", t, 20)
+    t = ff.pool2d("gap", t, 16, 16, 1, 1, 0, 0, pool_type="avg", relu=False)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 10, relu=False)
+    ff.softmax("softmax", t)
+
+    params, state = ff.init()
+    opt = ff.init_opt_state(params)
+    step = ff.make_train_step()
+    data = synthetic_batches(machine8, 8, 32, 32, num_classes=10,
+                             mode="random")
+    img_, lbl = next(data)
+    losses = []
+    for _ in range(3):
+        params, state, opt, loss = step(params, state, opt, img_, lbl)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    # BN state updated
+    assert "bn1" in state and float(np.abs(state["bn1"]["mean"]).max()) > 0
+
+
+def test_resnet_residual_small_train_step(machine8):
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.models.resnet import bottleneck_block
+
+    c = cfg(h=16, w=16, b=8, classes=10)
+    ff = FFModel(c, machine8)
+    img = ff.create_input((8, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 16, 3, 3, 1, 1, 1, 1, relu=True)
+    t = bottleneck_block(ff, "b1", t, 32, 8, 1, residual=True)
+    t = bottleneck_block(ff, "b2", t, 32, 8, 1, residual=True)
+    t = ff.pool2d("gap", t, 16, 16, 1, 1, 0, 0, pool_type="avg", relu=False)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 10, relu=False)
+    ff.softmax("softmax", t)
+
+    params, state = ff.init()
+    opt = ff.init_opt_state(params)
+    step = ff.make_train_step()
+    data = synthetic_batches(machine8, 8, 16, 16, num_classes=10,
+                             mode="random")
+    img_, lbl = next(data)
+    l0 = None
+    for i in range(4):
+        params, state, opt, loss = step(params, state, opt, img_, lbl)
+        if i == 0:
+            l0 = float(loss)
+    assert float(loss) < l0

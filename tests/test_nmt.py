@@ -1,0 +1,149 @@
+"""NMT subsystem tests: op numerics (LSTM vs manual reference), DAG
+structure parity, weight sharing semantics, end-to-end training, and
+strategy invariance for the RNN path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.nmt.rnn_model import (RnnConfig, RnnModel,
+                                        default_global_config,
+                                        synthetic_token_batches)
+from flexflow_tpu.ops.base import Tensor
+from flexflow_tpu.ops.embed import Embed
+from flexflow_tpu.ops.lstm import LSTMChunk
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+
+def small_cfg(**kw):
+    d = dict(batch_size=8, num_layers=2, seq_length=6, hidden_size=16,
+             embed_size=12, vocab_size=64, lstm_per_node_length=3,
+             learning_rate=0.1, seed=3)
+    d.update(kw)
+    return RnnConfig(**d)
+
+
+def test_embed_gather_and_grad():
+    op = Embed("e", ParallelConfig((1,), (0,)), Tensor((2, 3), "int32"),
+               vocab_size=10, embed_size=4)
+    params = op.init_params(jax.random.PRNGKey(0))
+    ids = jnp.asarray([[1, 2, 1], [0, 9, 1]], dtype=jnp.int32)
+    y, _ = op.forward(params, {}, [ids], True)
+    np.testing.assert_allclose(y[0, 0], params["table"][1])
+    np.testing.assert_allclose(y[1, 1], params["table"][9])
+
+    # scatter-add backward: grad of sum(y) accumulates counts per row
+    g = jax.grad(
+        lambda p: op.forward(p, {}, [ids], True)[0].sum())(params)["table"]
+    np.testing.assert_allclose(g[1], 3.0 * np.ones(4), rtol=1e-6)  # id 1 x3
+    np.testing.assert_allclose(g[5], np.zeros(4))
+
+
+def test_lstm_chunk_matches_manual():
+    """LSTMChunk scan == hand-rolled per-step computation."""
+    B, L, E, H = 2, 4, 3, 5
+    op = LSTMChunk("l", ParallelConfig((1,), (0,)), Tensor((B, L, E)),
+                   None, None, H)
+    params = op.init_params(jax.random.PRNGKey(1))
+    x = jnp.asarray(np.random.RandomState(0).randn(B, L, E),
+                    dtype=jnp.float32)
+    (y, hy, cy), _ = op.forward(params, {}, [x], True)
+
+    w_ih, w_hh, b = (np.asarray(params[k]) for k in ("w_ih", "w_hh", "b"))
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for t in range(L):
+        gates = np.asarray(x)[:, t] @ w_ih + h @ w_hh + b
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        np.testing.assert_allclose(np.asarray(y)[:, t], h, rtol=2e-4,
+                                   atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hy), h, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cy), c, rtol=2e-4, atol=1e-5)
+
+
+def test_rnn_model_structure(machine8):
+    cfg = small_cfg()
+    m = RnnModel(cfg, machine8)
+    names = [op.name for op in m.layers]
+    # 2 chunks per seq: 4 slices, 4 embeds, 2 layers x 4 lstms, 2 linear+softmax
+    assert sum(n.startswith("embed") for n in names) == 4
+    assert sum(n.startswith("lstm") for n in names) == 8
+    assert sum(n.startswith("linear") for n in names) == 2
+    assert sum(n.startswith("softmax") for n in names) == 2
+
+    params, state = m.init()
+    # shared variables parity (nmt/rnn.cu:328-336): srcEmbed, dstEmbed,
+    # encoder/decoder per layer, one linear
+    assert set(params.keys()) == {
+        "srcEmbed", "dstEmbed", "encoder0", "encoder1",
+        "decoder0", "decoder1", "linear"}
+
+
+def test_rnn_trains(machine8):
+    cfg = small_cfg(learning_rate=2.0)  # tiny net + per-token-mean loss
+    m = RnnModel(cfg, machine8)
+    one = next(synthetic_token_batches(machine8, cfg.batch_size,
+                                       cfg.seq_length, cfg.vocab_size,
+                                       seed=11))
+
+    def repeat():
+        while True:
+            yield one
+
+    out = m.fit(repeat(), num_iterations=10, warmup=1, log=lambda *a: None)
+    losses = out["loss"]
+    assert np.isfinite(losses).all()
+    # fixed batch is memorizable: loss must drop clearly
+    assert losses[-1] < losses[0] - 0.1, losses
+    # initial loss should be ~log(vocab)
+    assert abs(losses[0] - np.log(cfg.vocab_size)) < 1.0
+
+
+def test_rnn_strategy_invariance(machine8):
+    """Same trajectory under default strategy (embeds pinned, DP lstms) vs
+    a hybrid: vocab-sharded linears + batch-sharded everything."""
+    cfg = small_cfg()
+
+    def run(strategies):
+        m = RnnModel(cfg, machine8, strategies)
+        data = synthetic_token_batches(machine8, cfg.batch_size,
+                                       cfg.seq_length, cfg.vocab_size,
+                                       seed=5)
+        return m.fit(data, num_iterations=3, warmup=1,
+                     log=lambda *a: None)["loss"]
+
+    base = run(None)  # default_global_config
+
+    hybrid = default_global_config(cfg, machine8)
+    devs = tuple(range(8))
+    hybrid["linear0"] = ParallelConfig((4, 2), devs)   # vocab-sharded TP
+    hybrid["linear1"] = ParallelConfig((8, 1), devs)
+    hybrid["lstm0_0"] = ParallelConfig((4,), (0, 1, 2, 3))  # subset
+    hybrid["embed0"] = ParallelConfig((8,), devs)
+    got = run(hybrid)
+    np.testing.assert_allclose(base, got, rtol=2e-4, atol=2e-5)
+
+
+def test_rnn_weight_sharing_grads(machine8):
+    """Chunk ops sharing a param_key accumulate gradients (SharedVariable
+    semantics): encoder0 grads reflect both encoder chunks."""
+    cfg = small_cfg(num_layers=1)
+    m = RnnModel(cfg, machine8)
+    params, state = m.init()
+    data = synthetic_token_batches(machine8, cfg.batch_size, cfg.seq_length,
+                                   cfg.vocab_size, seed=2)
+    src, dst = next(data)
+
+    g = jax.grad(lambda p: m.loss_fn(p, state, src, dst)[0])(params)
+    assert float(jnp.abs(g["encoder0"]["w_ih"]).max()) > 0
+    assert float(jnp.abs(g["srcEmbed"]["table"]).max()) > 0
+    assert float(jnp.abs(g["linear"]["kernel"]).max()) > 0
