@@ -103,6 +103,50 @@ class FFModel:
     def softmax(self, name, input) -> Tensor:
         return self._add(Softmax(name, self._pc(name, 1), input))
 
+    # ---- sequence-model builders (transformer/NMT op family) ----------
+
+    def embed(self, name, input, vocab_size, embed_size,
+              param_key: str = None) -> Tensor:
+        from flexflow_tpu.ops.embed import Embed
+
+        return self._add(Embed(name, self._pc(name, 1), input, vocab_size,
+                               embed_size, param_key))
+
+    def pos_embed(self, name, input) -> Tensor:
+        from flexflow_tpu.ops.seq_common import PosEmbed
+
+        return self._add(PosEmbed(name, self._pc(name, 2), input))
+
+    def layer_norm(self, name, input) -> Tensor:
+        from flexflow_tpu.ops.seq_common import LayerNormSeq
+
+        return self._add(LayerNormSeq(name, self._pc(name, 2), input))
+
+    def add_seq(self, name, x: Tensor, y: Tensor) -> Tensor:
+        from flexflow_tpu.ops.seq_common import AddSeq
+
+        return self._add(AddSeq(name, self._pc(name, 2), [x, y]))
+
+    def attention(self, name, input, num_heads,
+                  causal: bool = False) -> Tensor:
+        from flexflow_tpu.ops.attention import MultiHeadAttention
+
+        return self._add(MultiHeadAttention(
+            name, self._pc(name, 3), input, num_heads, causal,
+            machine=self.machine))
+
+    def seq_linear(self, name, input, out_channels,
+                   param_key: str = None) -> Tensor:
+        from flexflow_tpu.ops.rnn_linear import RnnLinear
+
+        return self._add(RnnLinear(name, self._pc(name, 2), input,
+                                   out_channels, param_key))
+
+    def softmax_seq(self, name, logits: Tensor, labels: Tensor) -> Tensor:
+        from flexflow_tpu.ops.softmax_dp import SoftmaxDP
+
+        return self._add(SoftmaxDP(name, self._pc(name, 1), logits, labels))
+
     # ------------------------------------------------------------------
     # parameters
 
@@ -206,6 +250,23 @@ class FFModel:
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
+    def make_sgd_step(self, lr: float):
+        """Plain-SGD train step over ``self.loss_fn(params, state, *batch)``
+        — shared by the RNN and transformer subclasses (their reference
+        counterparts apply bare rate*grad updates, nmt/rnn.cu:684-702)."""
+        import jax
+
+        def train_step(params, state, opt_state, *batch):
+            def lf(p):
+                return self.loss_fn(p, state, *batch, train=True)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            return new_params, new_state, opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
     def make_eval_step(self):
         import jax
         import jax.numpy as jnp
@@ -241,14 +302,14 @@ class FFModel:
         start = time.perf_counter()
         loss = None
         for it in range(num_iterations):
-            image, labels = next(data_iter)
+            batch = next(data_iter)
             if it == warmup:
                 if loss is not None:
                     float(loss)  # sync (block_until_ready is unreliable
                                  # under the axon tunnel)
                 start = time.perf_counter()
             params, state, opt_state, loss = step(params, state, opt_state,
-                                                  image, labels)
+                                                  *batch)
             losses.append(loss)
             if self.config.print_freq and (it + 1) % self.config.print_freq == 0:
                 log(f"iter {it + 1}: loss = {float(loss):.4f}")

@@ -1,0 +1,110 @@
+"""Transformer language model family (BERT-base-shaped encoder or GPT-style
+causal decoder) built from the seq op family with full SOAP strategies:
+sample (n), heads/channels (h/c tensor parallelism), and sequence (s,
+ring-attention context parallelism) per layer.
+
+BASELINE.json config: "Transformer/BERT-base via linear+softmax ops, full
+SOAP strategy search".  This is new model capability beyond the reference
+(which predates transformers)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.strategy import Strategy
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    batch_size: int = 16
+    seq_length: int = 512
+    num_layers: int = 12           # BERT-base
+    d_model: int = 768
+    num_heads: int = 12
+    d_ff: int = 3072
+    vocab_size: int = 32768
+    causal: bool = False           # True = GPT-style next-token LM
+    learning_rate: float = 1e-3
+    num_iterations: int = 10
+    compute_dtype: str = "float32"
+    seed: int = 0
+
+
+class TransformerLM(FFModel):
+    """Token-level LM: embeddings -> N pre-norm blocks -> vocab projection
+    -> per-token CE (labels = tokens shifted when causal, else identity —
+    masked-LM-style denoising is a data-pipeline concern)."""
+
+    def __init__(self, t_config: TransformerConfig = None,
+                 machine: Optional[MachineModel] = None,
+                 strategies: Optional[Strategy] = None):
+        self.t = t_config or TransformerConfig()
+        ff_cfg = FFConfig(
+            batch_size=self.t.batch_size,
+            learning_rate=self.t.learning_rate,
+            weight_decay=0.0,
+            num_iterations=self.t.num_iterations,
+            compute_dtype=self.t.compute_dtype,
+            seed=self.t.seed,
+            strategies=strategies or Strategy(),
+        )
+        super().__init__(ff_cfg, machine)
+        self._build()
+
+    def _build(self):
+        t = self.t
+        self.tokens = self.create_input((t.batch_size, t.seq_length),
+                                        "int32", "tokens")
+        self.labels = self.create_input((t.batch_size, t.seq_length),
+                                        "int32", "labels")
+        x = self.embed("embed", self.tokens, t.vocab_size, t.d_model)
+        x = self.pos_embed("pos_embed", x)
+        for i in range(t.num_layers):
+            h = self.layer_norm(f"blk{i}_ln1", x)
+            h = self.attention(f"blk{i}_attn", h, t.num_heads,
+                               causal=t.causal)
+            x = self.add_seq(f"blk{i}_res1", x, h)
+            h = self.layer_norm(f"blk{i}_ln2", x)
+            h = self.seq_linear(f"blk{i}_ff1", h, t.d_ff)
+            h = self._gelu(f"blk{i}_gelu", h)
+            h = self.seq_linear(f"blk{i}_ff2", h, t.d_model)
+            x = self.add_seq(f"blk{i}_res2", x, h)
+        x = self.layer_norm("final_ln", x)
+        logits = self.seq_linear("lm_head", x, t.vocab_size)
+        self.softmax_seq("softmax", logits, self.labels)
+        self.loss_op = self.layers[-1]
+
+    def _gelu(self, name, x):
+        from flexflow_tpu.ops.seq_common import GeluSeq
+
+        return self._add(GeluSeq(name, self._pc(name, 2), x))
+
+    # ------------------------------------------------------------------
+
+    def loss_fn(self, params, state, tokens, labels, train: bool = True):
+        inputs = {self.tokens.tid: tokens, self.labels.tid: labels}
+        values, new_state = self.apply(params, state, inputs, train)
+        op = self.loss_op
+        total = op.loss(values[op.output.tid], values[op.labels_tensor.tid])
+        return total / (self.t.batch_size * self.t.seq_length), new_state
+
+    def make_train_step(self):
+        return self.make_sgd_step(self.t.learning_rate)
+
+
+def build_bert_base(machine=None, strategies=None,
+                    **overrides) -> TransformerLM:
+    cfg = TransformerConfig(**overrides)
+    return TransformerLM(cfg, machine, strategies)
+
+
+def build_gpt_style(machine=None, strategies=None,
+                    **overrides) -> TransformerLM:
+    overrides.setdefault("causal", True)
+    cfg = TransformerConfig(**overrides)
+    return TransformerLM(cfg, machine, strategies)

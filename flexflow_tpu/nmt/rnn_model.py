@@ -183,50 +183,17 @@ class RnnModel(FFModel):
         return total / ntokens, new_state
 
     def make_train_step(self):
-        import jax
-
-        lr = self.rnn.learning_rate
-
-        def train_step(params, state, opt_state, src, dst):
-            def lf(p):
-                return self.loss_fn(p, state, src, dst, train=True)
-
-            (loss, new_state), grads = jax.value_and_grad(
-                lf, has_aux=True)(params)
-            new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-            return new_params, new_state, opt_state, loss
-
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        """Plain SGD on summed chunk grads (reference rate*grad updates,
+        nmt/rnn.cu:684-702) — shared factory in FFModel."""
+        return self.make_sgd_step(self.rnn.learning_rate)
 
     def fit(self, data_iter, num_iterations: Optional[int] = None,
             warmup: int = 1, log=print):
-        """Timed loop with the reference's print format
-        (nmt/nmt.cc:70-83: seconds per chunk of iterations)."""
-        num_iterations = num_iterations or self.rnn.num_iterations
-        warmup = min(warmup, max(num_iterations - 1, 0))
-        params, state = self.init()
-        step = self.make_train_step()
-        losses = []
-        start = time.perf_counter()
-        loss = None
-        for it in range(num_iterations):
-            src, dst = next(data_iter)
-            if it == warmup:
-                if loss is not None:
-                    float(loss)
-                start = time.perf_counter()
-            params, state, _, loss = step(params, state, None, src, dst)
-            losses.append(loss)
-        if loss is not None:
-            float(loss)
-        elapsed = time.perf_counter() - start
-        n_timed = num_iterations - warmup
-        log(f"time = {elapsed:.4f}s")
-        tput = (n_timed * self.rnn.batch_size / elapsed
-                if elapsed > 0 and n_timed > 0 else 0.0)
-        return {"params": params, "state": state,
-                "loss": [float(l) for l in losses],
-                "elapsed_s": elapsed, "sentences_per_sec": tput}
+        out = super().fit(data_iter,
+                          num_iterations or self.rnn.num_iterations,
+                          warmup, log)
+        out["sentences_per_sec"] = out["images_per_sec"]
+        return out
 
 
 def synthetic_token_batches(machine: MachineModel, batch_size: int,

@@ -1,0 +1,115 @@
+"""Multi-head attention with a full SOAP grid: ('s', 'h', 'n') = sequence
+(context parallelism) x heads (tensor parallelism) x batch (data
+parallelism).
+
+Execution paths:
+  * s-parts == 1: blockwise (flash-style streaming-softmax) attention in
+    plain XLA; head/batch sharding handled by GSPMD from the specs.
+  * s-parts > 1 on a canonical full-device grid: explicit ring attention
+    (shard_map + ppermute over the 's' mesh axis, see
+    parallel/ring_attention.py) — K/V blocks rotate on neighbor links, O(S/P)
+    memory per chip.
+
+New capability relative to the reference (which has no attention ops,
+SURVEY.md §2.6); cited rows: CP/ring-attention, SP."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class MultiHeadAttention(Op):
+    AXIS_NAMES = ("s", "h", "n")
+
+    def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
+                 num_heads: int, causal: bool = False, machine=None):
+        super().__init__(name, pc, [input])
+        assert input.ndim == 3
+        n, s, d = input.shape
+        assert d % num_heads == 0, "d_model must divide into heads"
+        self.num_heads = num_heads
+        self.head_dim = d // num_heads
+        self.d_model = d
+        self.causal = causal
+        self.machine = machine  # needed for the explicit ring-attention mesh
+        self.output = Tensor(input.shape, input.dtype, self, name)
+
+    def init_params(self, rng) -> Dict:
+        import jax
+        import jax.numpy as jnp
+
+        d = self.d_model
+        keys = jax.random.split(rng, 4)
+        init = jax.nn.initializers.glorot_uniform()
+        return {
+            "wq": init(keys[0], (d, d), "float32"),
+            "wk": init(keys[1], (d, d), "float32"),
+            "wv": init(keys[2], (d, d), "float32"),
+            "wo": init(keys[3], (d, d), "float32"),
+            "bo": jnp.zeros((d,), "float32"),
+        }
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        # q/k/v projections column-sharded by heads, output row-sharded
+        return {"wq": P(None, "h"), "wk": P(None, "h"), "wv": P(None, "h"),
+                "wo": P("h", None), "bo": P(None)}
+
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", "s", None)
+
+    def _use_ring(self) -> bool:
+        s_parts = self.pc.dims[0]
+        return (s_parts > 1 and self.machine is not None
+                and self.machine.is_canonical(self.pc))
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax.numpy as jnp
+
+        from flexflow_tpu.parallel.ring_attention import (
+            blockwise_attention, ring_attention)
+
+        (x,) = xs
+        b, s, d = x.shape
+        h, hd = self.num_heads, self.head_dim
+
+        def proj(w):
+            y = jnp.einsum("bsd,de->bse", x, w.astype(x.dtype),
+                           preferred_element_type=jnp.float32).astype(x.dtype)
+            return y.reshape(b, s, h, hd).transpose(0, 2, 1, 3)  # (B,H,S,hd)
+
+        q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
+        if self._use_ring():
+            mesh = self.machine.mesh_for(self.pc, self.AXIS_NAMES)
+            out = ring_attention(q, k, v, mesh, "s", self.causal)
+        else:
+            out = blockwise_attention(q, k, v, self.causal,
+                                      block_size=min(s, 512))
+        out = out.astype(x.dtype).transpose(0, 2, 1, 3).reshape(b, s, d)
+        y = jnp.einsum("bsd,de->bse", out, params["wo"].astype(x.dtype),
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        return y + params["bo"].astype(x.dtype), state
+
+    def local_clone(self, pc: ParallelConfig):
+        ps, ph, pn = pc.dims
+        n, s, d = self.inputs[0].shape
+        if n % pn or s % ps or self.num_heads % ph:
+            return None
+        # heads-sharded clone keeps d_model/heads ratio by shrinking d
+        t = Tensor((n // pn, s // ps, d // ph))
+        return MultiHeadAttention(self.name, ParallelConfig((1, 1, 1), (0,)),
+                                  t, self.num_heads // ph, self.causal)
+
+    def flops_per_sample(self) -> float:
+        s, d = self.output.shape[1], self.d_model
+        return 8.0 * s * d * d + 4.0 * s * s * d
+
+    def param_bytes(self) -> int:
+        return 4 * (4 * self.d_model * self.d_model + self.d_model)

@@ -1,0 +1,8 @@
+"""Distributed execution primitives beyond plain GSPMD: explicit ring
+collectives for sequence/context parallelism (capability extension over the
+reference, which has no attention at all — SURVEY.md §2.6 CP row)."""
+
+from flexflow_tpu.parallel.ring_attention import (blockwise_attention,
+                                                  ring_attention)
+
+__all__ = ["blockwise_attention", "ring_attention"]

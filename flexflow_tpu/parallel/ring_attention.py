@@ -1,0 +1,139 @@
+"""Ring attention: context parallelism over the sequence axis.
+
+Each device holds a sequence shard of Q, K, V.  K/V blocks rotate around the
+ring via ``ppermute`` while every device accumulates its queries' attention
+over the passing blocks with numerically-stable streaming softmax
+(flash-attention-style running max / denominator).  Communication rides
+neighbor links (ICI-friendly); memory per chip is O(S/P).  Backward is jax
+autodiff through the scan + ppermute (the transpose of a ring is the
+reverse ring).
+
+This is new capability relative to the reference (no attention ops exist
+there); it fills the CP/ring-attention row of SURVEY.md §2.6 and is the
+long-context path required of the framework.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _stream_block(q, k, v, m, l, acc, mask):
+    """One streaming-softmax accumulation step.
+
+    q: (B, H, Sq, d), k/v: (B, H, Sk, d); m/l: (B, H, Sq); acc like q.
+    mask: (Sq, Sk) additive (-inf where disallowed) or None.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = s + mask
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> nan
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    if mask is not None:
+        p = jnp.where(jnp.isinf(s), 0.0, p)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(p.dtype),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def _causal_mask(sq: int, sk: int, q_off, k_off):
+    qpos = q_off + jnp.arange(sq)[:, None]
+    kpos = k_off + jnp.arange(sk)[None, :]
+    return jnp.where(qpos >= kpos, 0.0, -jnp.inf)
+
+
+def blockwise_attention(q, k, v, causal: bool = False,
+                        block_size: Optional[int] = None,
+                        q_offset: int = 0, k_offset: int = 0):
+    """Single-device streaming attention over K/V blocks (O(S_block^2)
+    memory).  q,k,v: (B, H, S, d) -> (B, H, Sq, d), float32 out."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bs = block_size or sk
+    m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    acc = jnp.zeros((b, h, sq, d), jnp.float32)
+    qf = q.astype(jnp.float32)
+    for start in range(0, sk, bs):
+        kb = k[:, :, start:start + bs].astype(jnp.float32)
+        vb = v[:, :, start:start + bs]
+        mask = _causal_mask(sq, kb.shape[2], q_offset,
+                            k_offset + start) if causal else None
+        m, l, acc = _stream_block(qf, kb, vb, m, l, acc, mask)
+    l = jnp.maximum(l, 1e-30)
+    return acc / l[..., None]
+
+
+def ring_attention(q, k, v, mesh, seq_axis: str, causal: bool = False):
+    """Ring attention under shard_map.
+
+    q,k,v: GLOBAL (B, H, S, d) arrays; ``mesh`` must contain ``seq_axis``
+    (sequence shards) — other mesh axes may shard batch/heads and are passed
+    through untouched.  Returns global (B, H, S, d) float32.
+    """
+    import inspect
+
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+        _check_kw = ("check_vma"
+                     if "check_vma" in inspect.signature(shard_map).parameters
+                     else "check_rep")
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+        _check_kw = "check_rep"
+
+    axes = dict(mesh.shape)
+    p = axes[seq_axis]
+    if p == 1:
+        return blockwise_attention(q, k, v, causal)
+
+    # batch/head sharding: use 'n' / 'h' axes when present in the mesh
+    n_ax = "n" if "n" in axes and axes["n"] > 1 else None
+    h_ax = "h" if "h" in axes and axes["h"] > 1 else None
+    spec = P(n_ax, h_ax, seq_axis, None)
+
+    def local(ql, kl, vl):
+        s_local = ql.shape[2]
+        idx = lax.axis_index(seq_axis)
+        b, h, sq, d = ql.shape
+        m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+        l = jnp.zeros((b, h, sq), jnp.float32)
+        acc = jnp.zeros((b, h, sq, d), jnp.float32)
+        qf = ql.astype(jnp.float32)
+        q_off = idx * s_local
+        perm = [(i, (i + 1) % p) for i in range(p)]
+
+        def step(carry, t):
+            kb, vb, m, l, acc = carry
+            src = (idx - t) % p  # whose block we currently hold
+            k_off = src * s_local
+            mask = _causal_mask(sq, s_local, q_off, k_off) if causal else None
+            m, l, acc = _stream_block(qf, kb.astype(jnp.float32), vb,
+                                      m, l, acc, mask)
+            kb = lax.ppermute(kb, seq_axis, perm)
+            vb = lax.ppermute(vb, seq_axis, perm)
+            return (kb, vb, m, l, acc), 0.0
+
+        (kb, vb, m, l, acc), _ = lax.scan(step, (kl, vl, m, l, acc),
+                                          jnp.arange(p))
+        l = jnp.maximum(l, 1e-30)
+        return acc / l[..., None]
+
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, **{_check_kw: False})(q, k, v)

@@ -66,19 +66,27 @@ class MeasuredCostModel:
 
     def __init__(self, cache_path: Optional[str] = None,
                  fallback: Optional[AnalyticCostModel] = None,
-                 repeats: int = 5):
+                 repeats: int = 5, save_every: int = 32):
         self.cache_path = cache_path
         self.repeats = repeats
         self.fallback = fallback or AnalyticCostModel()
+        self.save_every = save_every
+        self._dirty = 0
+        self._warned_kinds = set()
         self._cache: Dict[str, float] = {}
         if cache_path and os.path.exists(cache_path):
             with open(cache_path) as f:
                 self._cache = json.load(f)
 
-    def _save(self):
-        if self.cache_path:
-            with open(self.cache_path, "w") as f:
-                json.dump(self._cache, f, indent=1, sort_keys=True)
+    def _save(self, force: bool = False):
+        if not self.cache_path or (not force and self._dirty < self.save_every):
+            return
+        with open(self.cache_path, "w") as f:
+            json.dump(self._cache, f, indent=1, sort_keys=True)
+        self._dirty = 0
+
+    def flush(self):
+        self._save(force=True)
 
     def op_cost(self, op: Op, pc: ParallelConfig) -> float:
         key = self._key(op, pc)
@@ -88,6 +96,7 @@ class MeasuredCostModel:
         if t is None:
             t = self.fallback.op_cost(op, pc)
         self._cache[key] = t
+        self._dirty += 1
         self._save()
         return t
 
@@ -136,5 +145,13 @@ class MeasuredCostModel:
                 dt = time.perf_counter() - t0
                 best = min(best, dt)
             return best
-        except Exception:
+        except Exception as e:  # analytic fallback, but say so once per kind
+            kind = type(op).__name__
+            if kind not in self._warned_kinds:
+                self._warned_kinds.add(kind)
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "measured cost for %s failed (%s: %s); "
+                    "using analytic fallback", kind, type(e).__name__, e)
             return None

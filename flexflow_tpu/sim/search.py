@@ -60,6 +60,17 @@ def op_geometry(op: Op, pc: ParallelConfig):
     return pts
 
 
+def _in_window(out_lo: int, out_hi: int, stride: int, kernel: int,
+               pad: int, extent: int) -> Tuple[int, int]:
+    """Input rows a [out_lo, out_hi) output tile needs: stride mapping plus
+    kernel halo (the overlap Legion's image partitions carry and the
+    reference's restriction-partitioned inputs exchange, conv_2d.cu:93-113).
+    Clamped to the tensor."""
+    lo = out_lo * stride - pad
+    hi = (out_hi - 1) * stride - pad + kernel
+    return max(lo, 0), min(hi, extent)
+
+
 def _point_geometry(op: Op, kind: str, dims, idx):
     i0 = op.inputs[0] if op.inputs else None
     if kind in ("Conv2D", "Pool2D", "BatchNorm", "Add", "Concat"):
@@ -73,11 +84,21 @@ def _point_geometry(op: Op, kind: str, dims, idx):
             tn, th, tw, tc = t.shape
             if kind in ("BatchNorm", "Add"):
                 cr = _split(tc, pcc, ic)
-            else:  # conv/pool read all input channels; concat reads each
-                   # input's full channel range (its slice of the output)
+                hr = _split(th, ph, ih)
+                wr = _split(tw, pw, iw)
+            elif kind == "Concat":
+                cr = (0, tc)  # each input's own full channel range
+                hr = _split(th, ph, ih)
+                wr = _split(tw, pw, iw)
+            else:  # conv/pool: all input channels + stride/halo windows
                 cr = (0, tc)
-            ins.append(_rect(_split(tn, pn, in_), _split(th, ph, ih),
-                             _split(tw, pw, iw), cr))
+                olo, ohi = _split(oh, ph, ih)
+                hr = _in_window(olo, ohi, op.stride_h, op.kernel_h,
+                                op.padding_h, th)
+                olo, ohi = _split(ow, pw, iw)
+                wr = _in_window(olo, ohi, op.stride_w, op.kernel_w,
+                                op.padding_w, tw)
+            ins.append(_rect(_split(tn, pn, in_), hr, wr, cr))
         return out, ins
     if kind == "Flat":
         pcc, pn = dims
@@ -129,6 +150,27 @@ def _point_geometry(op: Op, kind: str, dims, idx):
         n, l, e = op.output.shape
         out = _rect(_split(n, pn, in_), (0, l), (0, e))
         return out, [_rect(_split(n, pn, in_), (0, l))]
+    if kind in ("LayerNormSeq", "AddSeq", "PosEmbed", "GeluSeq"):
+        ps, pn = dims
+        is_, in_ = idx
+        n, l, d = op.output.shape
+        out = _rect(_split(n, pn, in_), _split(l, ps, is_), (0, d))
+        ins = []
+        for t in op.inputs:
+            ins.append(_rect(_split(t.shape[0], pn, in_),
+                             _split(t.shape[1], ps, is_), (0, t.shape[2])))
+        return out, ins
+    if kind == "MultiHeadAttention":
+        ps, ph, pn = dims
+        is_, ih, in_ = idx
+        n, l, d = op.output.shape
+        out = _rect(_split(n, pn, in_), _split(l, ps, is_),
+                    _split(d, ph, ih))
+        # ring attention: each shard consumes its own s-slice of x (K/V
+        # rotation cost rides neighbor links, not producer->consumer edges)
+        tn, tl, td = op.inputs[0].shape
+        return out, [_rect(_split(tn, pn, in_), _split(tl, ps, is_),
+                           (0, td))]
     if kind == "LSTMChunk":
         (pn,) = dims
         (in_,) = idx
@@ -166,6 +208,12 @@ def _axis_extents(op: Op) -> Dict[str, List[int]]:
     if kind == "RnnLinear":
         n, _, v = op.output.shape
         return {"c": [v], "n": [n]}
+    if kind in ("LayerNormSeq", "AddSeq", "PosEmbed", "GeluSeq"):
+        n, l, _ = op.output.shape
+        return {"s": [l], "n": [n]}
+    if kind == "MultiHeadAttention":
+        n, l, d = op.output.shape
+        return {"s": [l], "h": [op.num_heads, d], "n": [n]}
     return {"n": [op.output.shape[0]]}
 
 
@@ -262,6 +310,8 @@ class StrategySearch:
             else:
                 seen_param_keys.add(op.param_key)
                 pbytes.append(float(op.param_bytes()))
+        if hasattr(self.cost_model, "flush"):
+            self.cost_model.flush()
         dbls = [topo.ici_bandwidth, topo.dcn_bandwidth, topo.ici_latency]
         dbls.extend(pbytes)
         dbls.extend(costs)
