@@ -1,0 +1,25 @@
+"""Data input subsystem.
+
+Three sources, mirroring the reference's three loaders (SURVEY.md §2.1):
+
+  * :func:`synthetic_batches` — synthetic images/labels (reference:
+    init_images_task/init_labels_task, model.cu:213-257), the default when
+    no ``-d`` flag is given;
+  * :class:`ImageDataset` / :func:`image_batches` — ImageNet-style
+    ``<root>/train/<label>/<file>.jpg`` directory tree with native threaded
+    JPEG decode (reference: DataLoader + load_images_task +
+    normalize_images_task, model.cc:156-205, model.cu:97-211);
+  * :func:`hdf5_batches` — HDF5 batch files, round-robin with prefetch
+    (reference legacy loader, ops.cu:281-420).
+"""
+
+from flexflow_tpu.data.synthetic import synthetic_batches
+from flexflow_tpu.data.imagenet import ImageDataset, image_batches
+from flexflow_tpu.data.hdf5 import hdf5_batches
+
+__all__ = [
+    "synthetic_batches",
+    "ImageDataset",
+    "image_batches",
+    "hdf5_batches",
+]

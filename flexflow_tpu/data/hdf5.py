@@ -1,0 +1,107 @@
+"""HDF5 batch loader — parity with the reference's legacy DataLoader
+(ops.h:545-565, ops.cu:281-420): a list of HDF5 files, each holding an
+``images`` and a ``labels`` dataset, consumed round-robin with wraparound
+inside each file and a background prefetch thread (the reference prefetches
+the next batch into zero-copy memory while the current one trains).
+
+Images may be stored uint8 HWC (normalized here with the same
+``(u8/256 - mean)/std`` rule as the JPEG path) or float32 (passed through).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.data.imagenet import IMAGENET_MEAN, IMAGENET_STD
+
+
+def _read_batch(files: List, positions: List[int], file_idx: int,
+                batch_size: int):
+    """Read one batch from files[file_idx] at its cursor, wrapping within
+    the file; advances the cursor. Returns (images, labels, next_file)."""
+    f = files[file_idx]
+    images, labels = f["images"], f["labels"]
+    n = images.shape[0]
+    start = positions[file_idx]
+    # wrap inside the file as many times as needed (covers batch_size > n)
+    img_parts, lbl_parts, need = [], [], batch_size
+    while need > 0:
+        take = min(need, n - start)
+        img_parts.append(images[start:start + take])
+        lbl_parts.append(labels[start:start + take])
+        start = (start + take) % n
+        need -= take
+    positions[file_idx] = start
+    img = img_parts[0] if len(img_parts) == 1 else np.concatenate(img_parts)
+    lbl = lbl_parts[0] if len(lbl_parts) == 1 else np.concatenate(lbl_parts)
+    return np.asarray(img), np.asarray(lbl), (file_idx + 1) % len(files)
+
+
+class _ProducerError:
+    """Sentinel carrying a prefetch-thread exception to the consumer."""
+
+    def __init__(self, exc: Exception):
+        self.exc = exc
+
+
+def _normalize(img: np.ndarray) -> np.ndarray:
+    if img.dtype == np.uint8:
+        return ((img.astype(np.float32) / 256.0 - IMAGENET_MEAN)
+                / IMAGENET_STD)
+    return img.astype(np.float32)
+
+
+def hdf5_batches(machine, paths: List[str], batch_size: int,
+                 prefetch: int = 2) -> Iterator[Tuple]:
+    """Yield (images, labels) forever from HDF5 batch files, prefetching on
+    a background thread."""
+    import h5py
+    import jax
+
+    from flexflow_tpu.data.synthetic import _batch_sharding
+
+    if not paths:
+        raise ValueError("hdf5_batches needs at least one file")
+    sharding = _batch_sharding(machine)
+    files = [h5py.File(p, "r") for p in paths]
+    positions = [0] * len(files)
+
+    q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    stop = threading.Event()
+
+    def producer():
+        idx = 0
+        while not stop.is_set():
+            try:
+                img, lbl, idx = _read_batch(files, positions, idx, batch_size)
+                item = (_normalize(img), np.asarray(lbl, np.int32))
+            except Exception as e:  # surface to the consumer, don't hang it
+                item = _ProducerError(e)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if isinstance(item, _ProducerError):
+                return
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, _ProducerError):
+                raise RuntimeError("hdf5 prefetch thread failed") from item.exc
+            img, lbl = item
+            yield (jax.device_put(img, sharding),
+                   jax.device_put(lbl, sharding))
+    finally:
+        stop.set()
+        t.join(timeout=2.0)
+        for f in files:
+            f.close()

@@ -1,0 +1,145 @@
+"""ImageNet-style directory dataset + training batch pipeline.
+
+Reference parity (model.cc:156-205, model.cu:97-211):
+
+  * dataset root holds ``train/<labelId>/<sample>`` and ``val/...``; each
+    subdirectory of the split is one class (we assign label indices by
+    sorted directory name, deterministically — the reference leaves the
+    mapping to readdir order);
+  * samples are (label, file) pairs; ``get_samples`` walks the list
+    sequentially with wraparound; ``shuffle_samples`` reshuffles in place;
+  * images are JPEG-decoded, nearest-neighbor-resized to the model's input
+    extent, and normalized ``(u8/256 - mean) / std`` with the ImageNet
+    mean/std (apply_normalize, model.cu:168-181) — in NHWC float32 (TPU
+    layout; the reference used NCHW).
+
+Decode runs on the native thread pool (native/dataloader.cc) with batches
+submitted ahead so JPEG decode overlaps device compute — the role of the
+reference's loader CPU processors + prefetching (``-ll:cpu``, ops.cu
+prefetch).  Falls back to PIL when the native library is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+class ImageDataset:
+    """(label, file) sample list for one split of a directory tree."""
+
+    def __init__(self, root: str, split: str = "train"):
+        split_dir = os.path.join(root, split)
+        if not os.path.isdir(split_dir):
+            raise FileNotFoundError(f"no {split!r} split under {root!r}")
+        self.root = root
+        self.split = split
+        self.class_names: List[str] = sorted(
+            d for d in os.listdir(split_dir)
+            if os.path.isdir(os.path.join(split_dir, d)))
+        self.samples: List[Tuple[int, str]] = []
+        for label, cls in enumerate(self.class_names):
+            cdir = os.path.join(split_dir, cls)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                if os.path.isfile(path):
+                    self.samples.append((label, path))
+        if not self.samples:
+            raise ValueError(f"empty dataset at {split_dir!r}")
+        self._pos = 0
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.class_names)
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def shuffle_samples(self, seed: Optional[int] = None) -> None:
+        """In-place reshuffle (DataLoader::shuffle_samples, model.cc:202-205),
+        deterministic when seeded."""
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(len(self.samples))
+        self.samples = [self.samples[i] for i in perm]
+        self._pos = 0
+
+    def get_samples(self, n: int) -> Tuple[List[int], List[str]]:
+        """Next n (label, file) pairs, wrapping around at the end of an epoch
+        (DataLoader::get_samples, model.cc:189-199)."""
+        labels, files = [], []
+        for _ in range(n):
+            if self._pos >= len(self.samples):
+                self._pos = 0
+            lbl, f = self.samples[self._pos]
+            self._pos += 1
+            labels.append(lbl)
+            files.append(f)
+        return labels, files
+
+
+def decode_batch_pil(files: List[str], height: int,
+                     width: int) -> np.ndarray:
+    """PIL fallback decode path, same resize/normalize semantics as the
+    native loader (nearest index = round(y*scale) clamped)."""
+    from PIL import Image
+
+    out = np.zeros((len(files), height, width, 3), np.float32)
+    for i, f in enumerate(files):
+        with Image.open(f) as im:
+            arr = np.asarray(im.convert("RGB"), np.uint8)
+        oh, ow = arr.shape[:2]
+        # floor(v + 0.5): half-away-from-zero, matching the native loader
+        # and the reference's roundf (np.round would round half to even)
+        ys = np.minimum(np.floor(np.arange(height) * (oh / height) + 0.5)
+                        .astype(np.int64), oh - 1)
+        xs = np.minimum(np.floor(np.arange(width) * (ow / width) + 0.5)
+                        .astype(np.int64), ow - 1)
+        resized = arr[ys][:, xs].astype(np.float32)
+        out[i] = (resized / 256.0 - IMAGENET_MEAN) / IMAGENET_STD
+    return out
+
+
+def image_batches(machine, dataset: ImageDataset, batch_size: int,
+                  height: int, width: int, num_threads: int = 4,
+                  prefetch: int = 2, shuffle_seed: Optional[int] = 0,
+                  use_native: bool = True) -> Iterator[Tuple]:
+    """Yield (images NHWC float32 sharded, labels int32 sharded) forever,
+    with `prefetch` batches of JPEG decode in flight."""
+    import jax
+
+    from flexflow_tpu.data.synthetic import _batch_sharding
+
+    if shuffle_seed is not None:
+        dataset.shuffle_samples(shuffle_seed)
+    sharding = _batch_sharding(machine)
+
+    loader = None
+    if use_native:
+        try:
+            from flexflow_tpu.data.native import NativeLoader
+
+            loader = NativeLoader(height, width, num_threads)
+        except RuntimeError:
+            loader = None
+
+    if loader is not None:
+        for _ in range(prefetch):
+            lbls, files = dataset.get_samples(batch_size)
+            loader.submit(files, lbls)
+        while True:
+            img, lbl = loader.next()
+            lbls, files = dataset.get_samples(batch_size)
+            loader.submit(files, lbls)  # keep the pipeline full
+            yield (jax.device_put(img, sharding),
+                   jax.device_put(lbl, sharding))
+    else:
+        while True:
+            lbls, files = dataset.get_samples(batch_size)
+            img = decode_batch_pil(files, height, width)
+            yield (jax.device_put(img, sharding),
+                   jax.device_put(np.asarray(lbls, np.int32), sharding))

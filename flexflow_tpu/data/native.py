@@ -1,0 +1,125 @@
+"""ctypes bindings for the native data loader (native/dataloader.cc):
+threaded JPEG decode + nearest-neighbor resize + ImageNet normalization.
+
+Built on demand like the native simulator (sim/native.py).  If the build or
+load fails (no libjpeg at runtime), callers fall back to the PIL path in
+imagenet.py — same spirit as the reference compiling the loader out behind
+USE_DATA_LOADER (model.cu:103).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libffdata.so")
+
+_lib = None
+_lib_failed = False
+
+
+def load_lib():
+    """Build+load libffdata.so; returns None when unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "libffdata.so"],
+                       check=True, capture_output=True)
+        lib = ctypes.CDLL(_LIB_PATH)
+    except (OSError, subprocess.CalledProcessError):
+        _lib_failed = True
+        return None
+    lib.ffdata_create.restype = ctypes.c_void_p
+    lib.ffdata_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.ffdata_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffdata_submit.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+    lib.ffdata_next.restype = ctypes.c_int
+    lib.ffdata_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.ffdata_decode.restype = ctypes.c_int
+    lib.ffdata_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float)]
+    _lib = lib
+    return lib
+
+
+def decode_image(path: str, height: int, width: int) -> Optional[np.ndarray]:
+    """Synchronously decode one JPEG to normalized float32 HWC.
+    Returns None if the native library is unavailable; raises on a bad file."""
+    lib = load_lib()
+    if lib is None:
+        return None
+    out = np.empty((height, width, 3), dtype=np.float32)
+    rc = lib.ffdata_decode(
+        path.encode(), height, width,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    if rc != 0:
+        raise IOError(f"ffdata_decode({path!r}) failed with code {rc}")
+    return out
+
+
+class NativeLoader:
+    """Asynchronous batch pipeline over the native thread pool.
+
+    ``submit`` enqueues (files, labels) batches (non-blocking); ``next``
+    blocks for the oldest batch, returning (images NHWC float32, labels
+    int32).  Keep >=2 batches in flight for decode/compute overlap — the
+    role of the reference's prefetch into zero-copy memory (ops.cu:313-420).
+    """
+
+    def __init__(self, height: int, width: int, num_threads: int = 4):
+        lib = load_lib()
+        if lib is None:
+            raise RuntimeError("native data loader unavailable")
+        self._lib = lib
+        self.height, self.width = height, width
+        self._handle = lib.ffdata_create(height, width, num_threads)
+        if not self._handle:
+            raise RuntimeError("ffdata_create failed")
+        self._pending_sizes = []
+
+    def submit(self, files: Sequence[str], labels: Sequence[int]) -> None:
+        n = len(files)
+        assert n == len(labels)
+        arr = (ctypes.c_char_p * n)(*[f.encode() for f in files])
+        lbl = np.ascontiguousarray(labels, dtype=np.int32)
+        self._lib.ffdata_submit(
+            self._handle, arr, lbl.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)), n)
+        self._pending_sizes.append(n)
+
+    def next(self) -> Tuple[np.ndarray, np.ndarray]:
+        if not self._pending_sizes:
+            raise RuntimeError("next() with no submitted batch")
+        n = self._pending_sizes.pop(0)
+        img = np.empty((n, self.height, self.width, 3), dtype=np.float32)
+        lbl = np.empty((n,), dtype=np.int32)
+        rc = self._lib.ffdata_next(
+            self._handle,
+            img.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            lbl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != n:
+            raise RuntimeError(f"ffdata_next returned {rc}, expected {n}")
+        return img, lbl
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.ffdata_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -96,6 +96,9 @@ class TransformerLM(FFModel):
     def make_train_step(self):
         return self.make_sgd_step(self.t.learning_rate)
 
+    def init_opt_state(self, params):
+        return None  # plain SGD carries no state; skip the momentum buffers
+
 
 def build_bert_base(machine=None, strategies=None,
                     **overrides) -> TransformerLM:

@@ -187,6 +187,9 @@ class RnnModel(FFModel):
         nmt/rnn.cu:684-702) — shared factory in FFModel."""
         return self.make_sgd_step(self.rnn.learning_rate)
 
+    def init_opt_state(self, params):
+        return None  # plain SGD carries no state; skip the momentum buffers
+
     def fit(self, data_iter, num_iterations: Optional[int] = None,
             warmup: int = 1, log=print):
         out = super().fit(data_iter,

@@ -100,12 +100,18 @@ class MultiHeadAttention(Op):
     def local_clone(self, pc: ParallelConfig):
         ps, ph, pn = pc.dims
         n, s, d = self.inputs[0].shape
-        if n % pn or s % ps or self.num_heads % ph:
+        if ps > 1 or ph > 1:
+            # A standalone shard-shaped clone cannot represent ring-CP
+            # ((S/ps) x S scores against full-length K/V) or head-TP
+            # (d x d/ph projections) — it would under-measure by ps / ph.
+            # Fall back to the analytic roofline, whose flops/num_parts
+            # division IS exact for these grids (total work is preserved).
             return None
-        # heads-sharded clone keeps d_model/heads ratio by shrinking d
-        t = Tensor((n // pn, s // ps, d // ph))
+        if n % pn:
+            return None
+        t = Tensor((n // pn, s, d))
         return MultiHeadAttention(self.name, ParallelConfig((1, 1, 1), (0,)),
-                                  t, self.num_heads // ph, self.causal)
+                                  t, self.num_heads, self.causal)
 
     def flops_per_sample(self) -> float:
         s, d = self.output.shape[1], self.d_model
