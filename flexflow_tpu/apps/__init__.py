@@ -1,0 +1,10 @@
+"""Runnable driver apps — the equivalents of the reference's executables:
+
+  * ``python -m flexflow_tpu.apps.cnn <model> [flags]`` — CNN training
+    (reference: ./alexnet etc., cnn.cc top_level_task + parse_input_args)
+  * ``python -m flexflow_tpu.apps.nmt [flags]`` — seq2seq NMT training
+    (reference: nmt/nmt.cc)
+  * ``python -m flexflow_tpu.apps.search <model> [flags]`` — offline MCMC
+    strategy search writing a strategy file (reference: scripts/simulator.cc,
+    with the simulator→strategy-file loop closed)
+"""

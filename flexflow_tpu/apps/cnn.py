@@ -1,0 +1,102 @@
+"""CNN training driver — reference executable parity (cnn.cc:43-135
+top_level_task + parse_input_args cnn.cc:539-582).
+
+    python -m flexflow_tpu.apps.cnn alexnet -b 64 --lr 0.01 -i 10
+    python -m flexflow_tpu.apps.cnn inception -d /data/imagenet -s strat.pb
+
+Flags are FFConfig.from_args (reference -e/-b/--lr/--wd/-p/-d/-s set, plus
+TPU-native extras).  With no ``-d`` the input is synthetic, exactly like the
+reference (README.md:68); ``-d`` accepts an ImageNet-style directory or a
+comma-separated list of HDF5 batch files (the legacy loader's format).
+Prints the reference's metric line: ``time = %.4fs, tp = %.2f images/s``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel
+
+MODELS = {}
+
+
+def _builders():
+    global MODELS
+    if not MODELS:
+        from flexflow_tpu import models as zoo
+
+        MODELS = {
+            "alexnet": zoo.build_alexnet,
+            "vgg16": zoo.build_vgg16,
+            "vgg": zoo.build_vgg16,
+            "inception": zoo.build_inception_v3,
+            "inception_v3": zoo.build_inception_v3,
+            "resnet101": zoo.build_resnet101,
+            "resnet": zoo.build_resnet101,
+            "densenet121": zoo.build_densenet121,
+            "densenet": zoo.build_densenet121,
+        }
+    return MODELS
+
+
+def make_data(cfg: FFConfig, machine: MachineModel, dataset=None):
+    """Choose the input source the way the reference does: synthetic unless
+    -d was given (cnn.cc:79, README.md:68)."""
+    from flexflow_tpu.data import (hdf5_batches, image_batches,
+                                   synthetic_batches)
+
+    if cfg.synthetic_input or not cfg.dataset_path:
+        return synthetic_batches(machine, cfg.batch_size, cfg.input_height,
+                                 cfg.input_width, num_classes=cfg.num_classes,
+                                 mode="random", seed=cfg.seed)
+    if cfg.dataset_path.endswith((".h5", ".hdf5")):
+        return hdf5_batches(machine, cfg.dataset_path.split(","),
+                            cfg.batch_size)
+    return image_batches(machine, dataset, cfg.batch_size, cfg.input_height,
+                         cfg.input_width, num_threads=cfg.loaders_per_node,
+                         shuffle_seed=cfg.seed)
+
+
+def main(argv=None, log=print) -> dict:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0].startswith("-"):
+        model_name = "alexnet"
+    else:
+        model_name = argv.pop(0)
+    builders = _builders()
+    if model_name not in builders:
+        raise SystemExit(
+            f"unknown model {model_name!r}; choose from "
+            f"{sorted(set(builders))}")
+    cfg = FFConfig.from_args(argv)
+    machine = MachineModel()
+
+    # Scan a directory dataset BEFORE building the model so the classifier
+    # head matches the data: labels >= num_classes would silently clamp in
+    # the gathered cross-entropy instead of erroring under jit.
+    dataset = None
+    if cfg.dataset_path and not cfg.synthetic_input \
+            and not cfg.dataset_path.endswith((".h5", ".hdf5")):
+        from flexflow_tpu.data import ImageDataset
+
+        dataset = ImageDataset(cfg.dataset_path, "train")
+        if "--classes" in argv:
+            if dataset.num_classes > cfg.num_classes:
+                raise SystemExit(
+                    f"--classes {cfg.num_classes} but dataset has "
+                    f"{dataset.num_classes} class directories")
+        else:
+            cfg.num_classes = dataset.num_classes
+
+    ff = builders[model_name](cfg, machine)
+    log(ff.summary())
+    data = make_data(cfg, machine, dataset)
+    out = ff.fit(data, log=log)
+    out.pop("params", None)
+    out.pop("state", None)
+    return out
+
+
+if __name__ == "__main__":
+    main()

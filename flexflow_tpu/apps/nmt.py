@@ -1,0 +1,79 @@
+"""NMT seq2seq training driver — reference executable parity (nmt/nmt.cc:
+top_level_task, flags parse_input_args nmt/nmt.cc:235-267: -b batch size,
+-l layers, -s sequence length, -h hidden size, -e embed size).
+
+    python -m flexflow_tpu.apps.nmt -b 64 -l 2 -s 20 -h 2048 -e 2048
+
+Extras beyond the reference: --vocab, --iters, --chunk (LSTM steps per
+chunk op), --strategy <file>, --dtype, --seed.  Data is synthetic random
+token pairs (the reference initializes its word tensors with constants,
+nmt/rnn.cu:89-126).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.nmt.rnn_model import (RnnConfig, RnnModel,
+                                        synthetic_token_batches)
+from flexflow_tpu.strategy import Strategy
+
+
+def parse_args(argv) -> RnnConfig:
+    from flexflow_tpu.utils.flags import flag_stream
+
+    cfg = RnnConfig()
+    strategy_file = ""
+    for a, val in flag_stream(argv):
+        if a == "-b":
+            cfg.batch_size = int(val())
+        elif a == "-l":
+            cfg.num_layers = int(val())
+        elif a == "-s":
+            cfg.seq_length = int(val())
+        elif a == "-h":
+            cfg.hidden_size = int(val())
+        elif a == "-e":
+            cfg.embed_size = int(val())
+        elif a == "--vocab":
+            cfg.vocab_size = int(val())
+        elif a in ("-i", "--iters", "--iterations"):
+            cfg.num_iterations = int(val())
+        elif a == "--chunk":
+            cfg.lstm_per_node_length = int(val())
+        elif a == "--lr":
+            cfg.learning_rate = float(val())
+        elif a == "--dtype":
+            cfg.compute_dtype = val()
+        elif a == "--seed":
+            cfg.seed = int(val())
+        elif a == "--strategy":
+            strategy_file = val()
+        # unknown flags ignored, like the reference parser
+    cfg._strategy_file = strategy_file
+    return cfg
+
+
+def main(argv=None, log=print) -> dict:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cfg = parse_args(argv)
+    machine = MachineModel()
+    strategies = None
+    if getattr(cfg, "_strategy_file", ""):
+        strategies = Strategy.load(cfg._strategy_file)
+    model = RnnModel(cfg, machine, strategies)
+    log(f"NMT: {cfg.num_layers} layers, seq {cfg.seq_length} "
+        f"(chunks of {cfg.lstm_per_node_length}), hidden {cfg.hidden_size}, "
+        f"embed {cfg.embed_size}, vocab {cfg.vocab_size}, "
+        f"batch {cfg.batch_size}, {machine.num_devices} devices")
+    data = synthetic_token_batches(machine, cfg.batch_size, cfg.seq_length,
+                                   cfg.vocab_size, seed=cfg.seed)
+    out = model.fit(data, log=log)
+    out.pop("params", None)
+    out.pop("state", None)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Offline strategy search driver — reference executable parity
+(scripts/simulator.cc main :1420-1472), with the loop the reference leaves
+open closed: the found strategy is written to a strategy file the training
+drivers consume directly (SURVEY.md §2.5 note).
+
+    python -m flexflow_tpu.apps.search alexnet --devices 8 -o strat.json
+    python -m flexflow_tpu.apps.search inception --devices 32 \
+        --iters 250000 --measured -o strat.pb
+
+``--devices N`` searches for an N-device machine regardless of local
+hardware (the reference similarly models a 2x4 cluster from one box,
+scripts/simulator.cc:32-33).  ``--measured`` times real per-op shard
+computations on the local chip (scripts/cnn.h measure_* parity); default is
+the analytic MXU/HBM roofline.  ``-o x.json`` writes JSON; any other
+extension writes the reference-wire-compatible proto.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel, Topology
+
+
+def parse_args(argv):
+    opts = {
+        "model": "alexnet", "devices": None, "iters": 250_000,
+        "out": "", "measured": False, "batch_size": 64, "seed": 0,
+        "ici_group": None, "cache": "", "nmt": {},
+    }
+    from flexflow_tpu.utils.flags import flag_stream
+
+    args = list(argv)
+    if args and not args[0].startswith("-"):
+        opts["model"] = args.pop(0)
+    for a, val in flag_stream(args):
+        if a == "--devices":
+            opts["devices"] = int(val())
+        elif a in ("-i", "--iters"):
+            opts["iters"] = int(val())
+        elif a in ("-o", "--out"):
+            opts["out"] = val()
+        elif a == "--measured":
+            opts["measured"] = True
+        elif a == "--cache":
+            opts["cache"] = val()
+        elif a in ("-b", "--batch-size"):
+            opts["batch_size"] = int(val())
+        elif a == "--seed":
+            opts["seed"] = int(val())
+        elif a == "--ici-group":
+            opts["ici_group"] = int(val())
+    return opts
+
+
+def build_model(name: str, machine: MachineModel, batch_size: int):
+    if name == "nmt":
+        from flexflow_tpu.nmt.rnn_model import RnnConfig, RnnModel
+
+        return RnnModel(RnnConfig(batch_size=batch_size), machine)
+    if name in ("transformer", "gpt", "bert"):
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     TransformerLM)
+
+        return TransformerLM(TransformerConfig(batch_size=batch_size),
+                             machine)
+    from flexflow_tpu.apps.cnn import _builders
+
+    builders = _builders()
+    if name not in builders:
+        raise SystemExit(f"unknown model {name!r}")
+    cfg = FFConfig(batch_size=batch_size)
+    return builders[name](cfg, machine)
+
+
+def main(argv=None, log=print) -> dict:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    opts = parse_args(argv)
+
+    if opts["devices"]:
+        ici = opts["ici_group"] or opts["devices"]
+        machine = MachineModel.virtual(
+            opts["devices"], Topology(devices_per_ici_group=ici))
+    else:
+        machine = MachineModel()
+        if opts["ici_group"]:
+            machine.topology = Topology(
+                devices_per_ici_group=opts["ici_group"])
+
+    model = build_model(opts["model"], machine, opts["batch_size"])
+
+    cost_model = None
+    if opts["measured"]:
+        from flexflow_tpu.sim.cost_model import MeasuredCostModel
+
+        cost_model = MeasuredCostModel(cache_path=opts["cache"] or None)
+
+    from flexflow_tpu.sim.search import StrategySearch
+
+    search = StrategySearch(model, machine, cost_model=cost_model)
+    strategy, info = search.search(iters=opts["iters"], seed=opts["seed"])
+    result = {
+        "model": opts["model"],
+        "devices": machine.num_devices,
+        "dp_time_s": info["dp_time"],
+        "best_time_s": info["best_time"],
+        "speedup_vs_dp": info["speedup_vs_dp"],
+    }
+    log(json.dumps(result))
+    if opts["out"]:
+        strategy.save(opts["out"])
+        log(f"strategy written to {opts['out']}")
+    return {"strategy": strategy, **result}
+
+
+if __name__ == "__main__":
+    main()

@@ -56,19 +56,10 @@ class FFConfig:
         -b/--batch-size, --lr, --wd, -p/--print-freq, -d/--dataset,
         -s/--strategy, plus TPU-native extras (--dtype, --iters, --seed,
         --profiling)."""
+        from flexflow_tpu.utils.flags import flag_stream
+
         cfg = cls()
-        args = list(argv)
-        i = 0
-        while i < len(args):
-            a = args[i]
-
-            def val() -> str:
-                nonlocal i
-                i += 1
-                if i >= len(args):
-                    raise ValueError(f"flag {a!r} expects a value")
-                return args[i]
-
+        for a, val in flag_stream(argv):
             if a in ("-e", "--epochs"):
                 cfg.epochs = int(val())
             elif a in ("-b", "--batch-size"):
@@ -97,6 +88,11 @@ class FFConfig:
                 cfg.seed = int(val())
             elif a == "--profiling":
                 cfg.profiling = True
+            elif a == "--height":
+                cfg.input_height = int(val())
+            elif a == "--width":
+                cfg.input_width = int(val())
+            elif a == "--classes":
+                cfg.num_classes = int(val())
             # unknown flags are ignored, like the reference parser
-            i += 1
         return cfg

@@ -103,5 +103,6 @@ def hdf5_batches(machine, paths: List[str], batch_size: int,
     finally:
         stop.set()
         t.join(timeout=2.0)
-        for f in files:
-            f.close()
+        if not t.is_alive():  # never close files under an in-flight read
+            for f in files:
+                f.close()

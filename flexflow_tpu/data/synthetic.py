@@ -25,23 +25,42 @@ def _batch_sharding(machine: MachineModel):
 def synthetic_batches(machine: MachineModel, batch_size: int, height: int,
                       width: int, channels: int = 3, num_classes: int = 1000,
                       mode: str = "ones", seed: int = 0,
-                      dtype: str = "float32") -> Iterator[Tuple]:
+                      dtype: str = "float32",
+                      cycle: int = 2) -> Iterator[Tuple]:
     """Yield (image NHWC, labels) forever.
 
     mode="ones": image=1.0, label=1 — exact parity with model.cu:213-257.
     mode="random": fixed-seed Gaussian images / uniform labels, for tests
     where constant inputs would hide bugs.
+
+    ``cycle`` batches are generated up front, placed on device once, and
+    yielded round-robin, so the training loop does no host-side data work —
+    the point of synthetic input (the reference's init_images_task fills
+    device memory once).  ``cycle=0`` generates a fresh host batch every
+    iteration instead.
     """
     import jax
 
     img_sh = _batch_sharding(machine)
     lbl_sh = img_sh
     rng = np.random.RandomState(seed)
-    while True:
+
+    def make():
         if mode == "ones":
             img = np.ones((batch_size, height, width, channels), dtype)
             lbl = np.ones((batch_size,), np.int32)
         else:
             img = rng.randn(batch_size, height, width, channels).astype(dtype)
-            lbl = rng.randint(0, num_classes, size=(batch_size,)).astype(np.int32)
-        yield (jax.device_put(img, img_sh), jax.device_put(lbl, lbl_sh))
+            lbl = rng.randint(0, num_classes,
+                              size=(batch_size,)).astype(np.int32)
+        return (jax.device_put(img, img_sh), jax.device_put(lbl, lbl_sh))
+
+    if cycle:
+        ring = [make() for _ in range(1 if mode == "ones" else cycle)]
+        i = 0
+        while True:
+            yield ring[i % len(ring)]
+            i += 1
+    else:
+        while True:
+            yield make()

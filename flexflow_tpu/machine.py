@@ -62,6 +62,21 @@ class MachineModel:
         )
         self._mesh_cache: Dict[Tuple, "jax.sharding.Mesh"] = {}
 
+    @classmethod
+    def virtual(cls, num_devices: int,
+                topology: Optional[Topology] = None) -> "MachineModel":
+        """A machine model for OFFLINE strategy search over a cluster larger
+        than (or different from) the local hardware — the reference's
+        simulator models a 2-node x 4-GPU cluster from one box
+        (scripts/simulator.cc:32-33).  The device entries are placeholders;
+        meshes/shardings cannot be built, so use only with the simulator,
+        never to execute."""
+        m = cls.__new__(cls)
+        m.devices = list(range(num_devices))
+        m.topology = topology or Topology(devices_per_ici_group=num_devices)
+        m._mesh_cache = {}
+        return m
+
     @property
     def num_devices(self) -> int:
         return len(self.devices)

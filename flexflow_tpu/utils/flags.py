@@ -1,0 +1,31 @@
+"""Shared hand-rolled flag-loop mechanics for the reference-parity parsers
+(cnn.cc:539-582 / nmt/nmt.cc:235-267 style: positional scan, unknown flags
+ignored).  One place for the take-a-value and error behavior used by
+FFConfig.from_args, apps.nmt.parse_args, and apps.search.parse_args."""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence, Tuple
+
+
+def flag_stream(argv: Sequence[str]) -> Iterator[Tuple[str, "callable"]]:
+    """Yield (flag, take) pairs; ``take()`` consumes and returns the next
+    argument as the flag's value, raising ValueError at end-of-args.  Call
+    ``take`` at most once, before advancing the iterator."""
+    args = list(argv)
+    i = 0
+    while i < len(args):
+        a = args[i]
+        consumed = [False]
+
+        def take(a=a, consumed=consumed) -> str:
+            nonlocal i
+            assert not consumed[0], f"take() called twice for {a!r}"
+            consumed[0] = True
+            i += 1
+            if i >= len(args):
+                raise ValueError(f"flag {a!r} expects a value")
+            return args[i]
+
+        yield a, take
+        i += 1

@@ -1,0 +1,112 @@
+"""Driver app tests: flag parsing parity and tiny end-to-end runs on the
+8-device CPU mesh (reference executables: cnn.cc, nmt/nmt.cc,
+scripts/simulator.cc)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def test_cnn_flag_parity():
+    from flexflow_tpu.config import FFConfig
+
+    cfg = FFConfig.from_args(["-e", "3", "-b", "32", "--lr", "0.05",
+                              "--wd", "0.001", "-p", "2", "--height", "64",
+                              "--width", "48", "--classes", "10"])
+    assert cfg.epochs == 3 and cfg.batch_size == 32
+    assert cfg.learning_rate == 0.05 and cfg.weight_decay == 0.001
+    assert cfg.print_freq == 2
+    assert (cfg.input_height, cfg.input_width) == (64, 48)
+    assert cfg.num_classes == 10
+
+
+def test_cnn_app_end_to_end(machine8):
+    from flexflow_tpu.apps import cnn
+
+    msgs = []
+    out = cnn.main(["alexnet", "-b", "8", "-i", "2", "--height", "224",
+                    "--width", "224", "--classes", "8", "-p", "1"],
+                   log=msgs.append)
+    assert np.isfinite(out["loss"]).all()
+    assert any("images/s" in m for m in msgs)  # cnn.cc:127 metric line
+
+
+def test_cnn_app_with_dataset_and_strategy(machine8, tmp_path):
+    from PIL import Image
+
+    from flexflow_tpu.apps import cnn
+    from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+    root = tmp_path / "ds"
+    rng = np.random.RandomState(0)
+    for cls in ("a", "b"):
+        d = root / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            Image.fromarray(rng.randint(0, 255, (30, 30, 3), np.uint8)
+                            ).save(d / f"{i}.jpg")
+    s = Strategy()
+    # channel TP x DP (conv1's 55x55 output is odd, so no h/w split)
+    s["conv1"] = ParallelConfig((1, 1, 2, 4), tuple(range(8)))
+    sf = str(tmp_path / "strat.json")
+    s.save(sf)
+
+    out = cnn.main(["alexnet", "-b", "8", "-i", "2", "-d", str(root),
+                    "--height", "224", "--width", "224", "--classes", "2",
+                    "-s", sf], log=lambda *a: None)
+    assert np.isfinite(out["loss"]).all()
+
+
+def test_cnn_app_unknown_model():
+    from flexflow_tpu.apps import cnn
+
+    with pytest.raises(SystemExit):
+        cnn.main(["nosuchnet"])
+
+
+def test_nmt_flag_parity():
+    from flexflow_tpu.apps.nmt import parse_args
+
+    cfg = parse_args(["-b", "16", "-l", "3", "-s", "40", "-h", "256",
+                      "-e", "128", "--vocab", "512", "--chunk", "5"])
+    assert cfg.batch_size == 16 and cfg.num_layers == 3
+    assert cfg.seq_length == 40 and cfg.hidden_size == 256
+    assert cfg.embed_size == 128 and cfg.vocab_size == 512
+    assert cfg.lstm_per_node_length == 5
+
+
+def test_nmt_app_end_to_end(machine8):
+    from flexflow_tpu.apps import nmt
+
+    out = nmt.main(["-b", "8", "-l", "1", "-s", "4", "-h", "16", "-e", "16",
+                    "--vocab", "64", "--chunk", "2", "-i", "2"],
+                   log=lambda *a: None)
+    assert np.isfinite(out["loss"]).all()
+    assert "sentences_per_sec" in out
+
+
+def test_search_app_writes_loadable_strategy(machine8, tmp_path):
+    from flexflow_tpu.apps import search
+    from flexflow_tpu.strategy import Strategy, validate_strategy
+
+    sf = str(tmp_path / "found.pb")  # proto wire format path
+    msgs = []
+    out = search.main(["alexnet", "--devices", "8", "--iters", "300",
+                       "-b", "32", "-o", sf], log=msgs.append)
+    assert out["speedup_vs_dp"] >= 1.0  # MCMC keeps the best ever seen
+    loaded = Strategy.load(sf)
+    assert loaded.keys() == out["strategy"].keys()
+    validate_strategy(loaded, 8)
+    assert any(m.startswith("{") and "dp_time_s" in m for m in msgs)
+
+
+def test_search_app_virtual_machine_larger_than_local():
+    from flexflow_tpu.apps import search
+
+    out = search.main(["alexnet", "--devices", "32", "--iters", "200",
+                       "--ici-group", "8"], log=lambda *a: None)
+    assert out["devices"] == 32
+    for pc in out["strategy"].values():
+        assert all(0 <= d < 32 for d in pc.devices)
