@@ -28,7 +28,7 @@ def parse_args(argv):
     opts = {
         "model": "alexnet", "devices": None, "iters": 250_000,
         "out": "", "measured": False, "batch_size": 64, "seed": 0,
-        "ici_group": None, "cache": "", "nmt": {},
+        "ici_group": None, "cache": "",
     }
     from flexflow_tpu.utils.flags import flag_stream
 

@@ -26,6 +26,7 @@ class FFConfig:
     workers_per_node: int = 0      # -ll:gpu analog; 0 = use all local chips
     loaders_per_node: int = 4      # -ll:cpu analog (data-loader threads)
     profiling: bool = False
+    trace_dir: str = ""            # jax.profiler trace output (-lg:prof analog)
     synthetic_input: bool = True   # reference default when -d absent (README.md:68)
     dataset_path: str = ""
     strategy_file: str = ""
@@ -88,6 +89,8 @@ class FFConfig:
                 cfg.seed = int(val())
             elif a == "--profiling":
                 cfg.profiling = True
+            elif a == "--trace-dir":
+                cfg.trace_dir = val()
             elif a == "--height":
                 cfg.input_height = int(val())
             elif a == "--width":

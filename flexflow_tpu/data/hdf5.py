@@ -74,21 +74,32 @@ def hdf5_batches(machine, paths: List[str], batch_size: int,
     stop = threading.Event()
 
     def producer():
-        idx = 0
-        while not stop.is_set():
-            try:
-                img, lbl, idx = _read_batch(files, positions, idx, batch_size)
-                item = (_normalize(img), np.asarray(lbl, np.int32))
-            except Exception as e:  # surface to the consumer, don't hang it
-                item = _ProducerError(e)
+        # The producer owns the files: only it touches them, and it closes
+        # them after observing stop — so teardown can't race an in-flight
+        # read and a slow read can't leak the handles.
+        try:
+            idx = 0
             while not stop.is_set():
                 try:
-                    q.put(item, timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
-            if isinstance(item, _ProducerError):
-                return
+                    img, lbl, idx = _read_batch(files, positions, idx,
+                                                batch_size)
+                    item = (_normalize(img), np.asarray(lbl, np.int32))
+                except Exception as e:  # surface to consumer, don't hang it
+                    item = _ProducerError(e)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if isinstance(item, _ProducerError):
+                    return
+        finally:
+            for f in files:
+                try:
+                    f.close()
+                except Exception:
+                    pass
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
@@ -103,6 +114,3 @@ def hdf5_batches(machine, paths: List[str], batch_size: int,
     finally:
         stop.set()
         t.join(timeout=2.0)
-        if not t.is_alive():  # never close files under an in-flight read
-            for f in files:
-                f.close()

@@ -290,6 +290,8 @@ class FFModel:
 
     def fit(self, data_iter, num_iterations: Optional[int] = None,
             warmup: int = 1, log=print):
+        import contextlib
+
         import jax
 
         num_iterations = num_iterations or self.config.num_iterations
@@ -298,28 +300,42 @@ class FFModel:
         opt_state = self.init_opt_state(params)
         step = self.make_train_step()
 
+        trace_ctx = contextlib.nullcontext()
+        if getattr(self.config, "trace_dir", ""):
+            from flexflow_tpu.utils.profiling import trace
+
+            trace_ctx = trace(self.config.trace_dir)
+
         losses = []
         start = time.perf_counter()
         loss = None
-        for it in range(num_iterations):
-            batch = next(data_iter)
-            if it == warmup:
-                if loss is not None:
-                    float(loss)  # sync (block_until_ready is unreliable
-                                 # under the axon tunnel)
-                start = time.perf_counter()
-            params, state, opt_state, loss = step(params, state, opt_state,
-                                                  *batch)
-            losses.append(loss)
-            if self.config.print_freq and (it + 1) % self.config.print_freq == 0:
-                log(f"iter {it + 1}: loss = {float(loss):.4f}")
-        if loss is not None:
-            float(loss)
-        elapsed = time.perf_counter() - start
+        with trace_ctx:
+            for it in range(num_iterations):
+                batch = next(data_iter)
+                if it == warmup:
+                    if loss is not None:
+                        float(loss)  # sync (block_until_ready is unreliable
+                                     # under the axon tunnel)
+                    start = time.perf_counter()
+                params, state, opt_state, loss = step(
+                    params, state, opt_state, *batch)
+                losses.append(loss)
+                if self.config.print_freq \
+                        and (it + 1) % self.config.print_freq == 0:
+                    log(f"iter {it + 1}: loss = {float(loss):.4f}")
+            if loss is not None:
+                float(loss)
+            elapsed = time.perf_counter() - start
         n_timed = num_iterations - warmup
         throughput = (n_timed * self.config.batch_size / elapsed
                       if elapsed > 0 and n_timed > 0 else 0.0)
         log(f"time = {elapsed:.4f}s, tp = {throughput:.2f} images/s")
+        if self.config.profiling:
+            # Flag-gated per-op timing table (reference: per-task cudaEvent
+            # ms printed when `profiling` is set, conv_2d.cu:514-545).
+            from flexflow_tpu.utils.profiling import OpProfiler
+
+            log(OpProfiler(self).report())
         return {
             "params": params, "state": state,
             "loss": [float(l) for l in losses],

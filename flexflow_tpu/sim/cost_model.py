@@ -34,6 +34,14 @@ class TpuChipPerf:
 _MATMUL_OPS = {"Conv2D", "Linear", "LSTMChunk", "RnnLinear"}
 
 
+def shard_flops(op: Op, pc: ParallelConfig) -> float:
+    """Modeled fwd+bwd FLOPs of ONE shard: 3x forward (two extra GEMMs per
+    matmul in backward).  Single source of truth for the analytic cost model
+    and the profiler's attribution table."""
+    batch = op.output.shape[0]
+    return 3.0 * op.flops_per_sample() * batch / pc.num_parts
+
+
 class AnalyticCostModel:
     """Roofline: shard time = max(flops / eff_peak, bytes / eff_hbm), with
     fwd+bwd modeled as 3x forward (two extra GEMMs per matmul in backward —
@@ -44,8 +52,7 @@ class AnalyticCostModel:
 
     def op_cost(self, op: Op, pc: ParallelConfig) -> float:
         n_parts = pc.num_parts
-        batch = op.output.shape[0]
-        flops = 3.0 * op.flops_per_sample() * batch / n_parts
+        flops = shard_flops(op, pc)
         io_elems = sum(t.size() for t in op.inputs) + \
             sum(t.size() for t in (op.outputs or [op.output]))
         bytes_moved = 3.0 * 4.0 * io_elems / n_parts + op.param_bytes()

@@ -1,0 +1,146 @@
+"""Profiling / tracing subsystem.
+
+Reference parity (SURVEY.md §5 "Tracing / profiling"):
+
+  * per-op flag-gated timing — the reference brackets each leaf task with
+    cudaEvents when ``profiling`` is set and prints per-op ms
+    (conv_2d.cu:514-545, linear.cu:380-385, nmt/lstm.cu:219).  Under XLA the
+    whole training step is ONE fused program, so per-op times inside it are
+    not observable from the host; the TPU-native equivalent is
+    :class:`OpProfiler`, which times each op's real jitted fwd+bwd at its
+    shard-local shapes (same harness the simulator's MeasuredCostModel uses,
+    itself the analog of scripts/cnn.h measure_*_time) and prints a table.
+  * wall-clock via execution fence + Realm clock (cnn.cc:113-128) —
+    ``FFModel.fit``'s timed loop.
+  * Legion ``-lg:prof`` task-level tracing — :func:`trace`, a context
+    manager around ``jax.profiler`` producing TensorBoard/XProf traces of
+    the actual compiled program (the authoritative per-fusion timeline).
+
+TPU-native addition: :func:`compiled_cost` pulls FLOPs / bytes-accessed
+from XLA's cost analysis of the *compiled* step, giving a roofline summary
+that no isolated per-op timing can (XLA fuses across ops).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Dict, List, Optional
+
+from flexflow_tpu.ops.base import Op
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """XProf/TensorBoard trace of everything executed inside the block
+    (Legion -lg:prof analog).  View with tensorboard --logdir=<dir>."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclasses.dataclass
+class OpProfile:
+    name: str
+    kind: str
+    grid: tuple
+    out_shape: tuple
+    ms: float            # measured fwd+bwd wall-ms of one shard
+    gflops: float        # modeled fwd+bwd GFLOPs of one shard
+    measured: bool
+
+    @property
+    def tflops_per_sec(self) -> float:
+        return (self.gflops / 1e3) / (self.ms / 1e3) if self.ms > 0 else 0.0
+
+
+class OpProfiler:
+    """Per-op timing table for a model (the ``profiling`` flag's output).
+
+    Each op's fwd+grad is jitted in isolation at the shapes ONE device sees
+    under the op's ParallelConfig and timed on the local chip.  Isolated
+    timings over-count vs the fused step (XLA fuses elementwise ops into
+    neighbors), so the table is a per-op *attribution* guide, not an exact
+    decomposition — the exact timeline is :func:`trace`.
+    """
+
+    def __init__(self, model, repeats: int = 3):
+        self.model = model
+        self.repeats = repeats
+
+    def profile(self) -> List[OpProfile]:
+        from flexflow_tpu.sim.cost_model import (AnalyticCostModel,
+                                                 MeasuredCostModel,
+                                                 shard_flops)
+
+        measured = MeasuredCostModel(repeats=self.repeats)
+        analytic = AnalyticCostModel()
+        rows = []
+        for op in self.model.layers:
+            t = measured._measure(op, op.pc)
+            was_measured = t is not None
+            if t is None:
+                t = analytic.op_cost(op, op.pc)
+            gflops = shard_flops(op, op.pc) / 1e9
+            rows.append(OpProfile(
+                name=op.name, kind=type(op).__name__, grid=op.pc.dims,
+                out_shape=op.output.shape, ms=t * 1e3, gflops=gflops,
+                measured=was_measured))
+        return rows
+
+    def report(self, rows: Optional[List[OpProfile]] = None) -> str:
+        rows = rows if rows is not None else self.profile()
+        total = sum(r.ms for r in rows)
+        lines = [
+            f"{'op':<18s} {'kind':<12s} {'grid':<14s} "
+            f"{'shard ms':>9s} {'GFLOP':>8s} {'TFLOP/s':>8s} {'%':>5s}",
+        ]
+        for r in rows:
+            pct = 100.0 * r.ms / total if total else 0.0
+            mark = "" if r.measured else "~"
+            lines.append(
+                f"{r.name:<18s} {r.kind:<12s} {str(r.grid):<14s} "
+                f"{mark}{r.ms:>8.3f} {r.gflops:>8.2f} "
+                f"{r.tflops_per_sec:>8.2f} {pct:>4.1f}%")
+        lines.append(f"{'total (isolated, one shard)':<46s} {total:>8.3f} ms"
+                     "   [~ = analytic estimate]")
+        return "\n".join(lines)
+
+
+def compiled_cost(fn, *args) -> Dict[str, float]:
+    """FLOPs / bytes for the COMPILED program (XLA cost analysis) — what the
+    chip will actually run after fusion, per step."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*args).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):  # older jax returns one dict per device program
+        ca = ca[0] if ca else {}
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+    }
+
+
+def step_roofline(fn, *args, seconds_per_step: Optional[float] = None,
+                  perf=None) -> Dict[str, float]:
+    """Roofline summary of a train step: modeled FLOPs/bytes plus, when a
+    measured step time is supplied, achieved TFLOP/s and HBM GB/s."""
+    from flexflow_tpu.sim.cost_model import TpuChipPerf
+
+    perf = perf or TpuChipPerf()
+    cost = compiled_cost(fn, *args)
+    out = dict(cost)
+    out["model_flops_util_at_peak"] = (
+        cost["flops"] / perf.peak_flops if perf.peak_flops else 0.0)
+    if seconds_per_step and seconds_per_step > 0:
+        out["achieved_tflops"] = cost["flops"] / seconds_per_step / 1e12
+        out["achieved_hbm_gbps"] = (
+            cost["bytes_accessed"] / seconds_per_step / 1e9)
+        out["mxu_utilization"] = (
+            cost["flops"] / seconds_per_step / perf.peak_flops)
+    return out
