@@ -27,6 +27,8 @@ class FFConfig:
     loaders_per_node: int = 4      # -ll:cpu analog (data-loader threads)
     profiling: bool = False
     trace_dir: str = ""            # jax.profiler trace output (-lg:prof analog)
+    ckpt_dir: str = ""             # checkpoint/resume directory (TPU-native)
+    ckpt_freq: int = 0             # save every N iters (0 = final only)
     synthetic_input: bool = True   # reference default when -d absent (README.md:68)
     dataset_path: str = ""
     strategy_file: str = ""
@@ -91,6 +93,10 @@ class FFConfig:
                 cfg.profiling = True
             elif a == "--trace-dir":
                 cfg.trace_dir = val()
+            elif a == "--ckpt-dir":
+                cfg.ckpt_dir = val()
+            elif a == "--ckpt-freq":
+                cfg.ckpt_freq = int(val())
             elif a == "--height":
                 cfg.input_height = int(val())
             elif a == "--width":

@@ -58,6 +58,12 @@ class FFModel:
         return pc
 
     def _add(self, op: Op) -> Tensor:
+        for t in (op.outputs if op.outputs else [op.output]):
+            if any(s <= 0 for s in t.shape):
+                raise ValueError(
+                    f"op {op.name!r} produces an empty tensor {t.shape} — "
+                    f"input too small for the layer stack (e.g. AlexNet "
+                    f"needs 224x224 input)")
         op.validate_partitioning()
         self.layers.append(op)
         return op.output
@@ -295,10 +301,28 @@ class FFModel:
         import jax
 
         num_iterations = num_iterations or self.config.num_iterations
-        warmup = min(warmup, max(num_iterations - 1, 0))
         params, state = self.init()
         opt_state = self.init_opt_state(params)
         step = self.make_train_step()
+
+        # checkpoint/resume (TPU-native addition; the reference can only
+        # serialize the strategy, strategy.cc:62-86 — see utils/checkpoint)
+        start_iter = 0
+        ckpt_dir = getattr(self.config, "ckpt_dir", "")
+        ckpt_freq = getattr(self.config, "ckpt_freq", 0)
+        if ckpt_dir:
+            from flexflow_tpu.utils import checkpoint as ckpt
+
+            if ckpt.latest_step(ckpt_dir) is not None:
+                start_iter, params, state, opt_state = \
+                    ckpt.restore_checkpoint(ckpt_dir, self)
+                log(f"resumed from {ckpt_dir} at iteration {start_iter}")
+                # re-align a deterministic (seeded) data stream with the
+                # restored position so resume matches the uninterrupted run
+                for _ in range(min(start_iter, num_iterations)):
+                    next(data_iter)
+        warmup = start_iter + min(warmup,
+                                  max(num_iterations - start_iter - 1, 0))
 
         trace_ctx = contextlib.nullcontext()
         if getattr(self.config, "trace_dir", ""):
@@ -310,7 +334,7 @@ class FFModel:
         start = time.perf_counter()
         loss = None
         with trace_ctx:
-            for it in range(num_iterations):
+            for it in range(start_iter, num_iterations):
                 batch = next(data_iter)
                 if it == warmup:
                     if loss is not None:
@@ -323,9 +347,16 @@ class FFModel:
                 if self.config.print_freq \
                         and (it + 1) % self.config.print_freq == 0:
                     log(f"iter {it + 1}: loss = {float(loss):.4f}")
+                if ckpt_dir and ckpt_freq and (it + 1) % ckpt_freq == 0 \
+                        and it + 1 < num_iterations:
+                    ckpt.save_checkpoint(ckpt_dir, it + 1, params, state,
+                                         opt_state, self.config.strategies)
             if loss is not None:
                 float(loss)
             elapsed = time.perf_counter() - start
+        if ckpt_dir and start_iter < num_iterations:
+            ckpt.save_checkpoint(ckpt_dir, num_iterations, params, state,
+                                 opt_state, self.config.strategies)
         n_timed = num_iterations - warmup
         throughput = (n_timed * self.config.batch_size / elapsed
                       if elapsed > 0 and n_timed > 0 else 0.0)

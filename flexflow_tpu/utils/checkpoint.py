@@ -1,0 +1,215 @@
+"""Checkpoint / resume subsystem.
+
+The reference has NO weight checkpointing (SURVEY.md §5: only the *strategy*
+is serializable, strategy.cc:62-86) — any failure restarts training from
+scratch.  A complete framework needs durable training state, so this module
+adds it as a first-class subsystem:
+
+  * a checkpoint = (iteration, params, state, opt_state) + the model's
+    Strategy, so a resumed run executes under the same per-layer
+    parallelization;
+  * atomic directory commit (write to ``<dir>/tmp.<step>``, fsync, rename to
+    ``<dir>/step_<N>``) — a killed run never leaves a half-written
+    checkpoint that resume would trust;
+  * restore is **sharding-aware**: when given the model, every param lands
+    directly on its op's NamedSharding (same placement as ``FFModel.init``),
+    so resume does not funnel large trees through one device.
+
+Format: one ``arrays.npz`` of flattened ``a/b/c``-keyed leaves per tree,
+plus ``meta.json`` recording each leaf's dtype.  Plain numpy keeps the
+format dependency-free and inspectable; extension dtypes (bfloat16, fp8)
+round-trip by re-viewing the raw bytes as the recorded ml_dtypes dtype on
+load (np.savez alone degrades them to void).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_SEP = "/"
+
+
+def _flatten(tree: Dict, prefix: str = "") -> Dict[str, Any]:
+    flat = {}
+    for k, v in tree.items():
+        if _SEP in k:
+            raise ValueError(f"checkpoint key {k!r} may not contain {_SEP!r}")
+        path = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten(v, path + _SEP))
+        else:
+            flat[path] = v
+    return flat
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict:
+    tree: Dict = {}
+    for path, v in flat.items():
+        keys = path.split(_SEP)
+        node = tree
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = v
+    return tree
+
+
+def _step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step_{step:08d}")
+
+
+def _list_steps(ckpt_dir: str) -> list:
+    """Sorted committed checkpoint steps in ``ckpt_dir``."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[5:]))
+            except ValueError:
+                continue
+    return sorted(steps)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    """Highest completed checkpoint step in ``ckpt_dir``, or None."""
+    steps = _list_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def save_checkpoint(ckpt_dir: str, step: int, params: Dict, state: Dict,
+                    opt_state: Dict, strategy=None, keep: int = 3) -> str:
+    """Write checkpoint atomically; prune to the newest ``keep`` steps.
+    Returns the committed directory."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tmp = os.path.join(ckpt_dir, f"tmp.{step}")
+    final = _step_dir(ckpt_dir, step)
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    arrays: Dict[str, np.ndarray] = {}
+    dtypes: Dict[str, str] = {}
+    for tree_name, tree in (("params", params), ("state", state),
+                            ("opt", opt_state)):
+        for path, leaf in _flatten(tree, tree_name + _SEP).items():
+            a = np.asarray(leaf)
+            arrays[path] = a
+            dtypes[path] = str(a.dtype)
+    np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+
+    meta = {"step": int(step), "format": 1, "dtypes": dtypes}
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    if strategy is not None and len(strategy):
+        strategy.save(os.path.join(tmp, "strategy.json"))
+
+    # durable commit: flush file data, then the tmp dir entry, then rename,
+    # then flush the parent so the rename itself is on disk
+    for name in os.listdir(tmp):
+        fd = os.open(os.path.join(tmp, name), os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    for d in (tmp,):
+        fd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    if os.path.exists(final):
+        shutil.rmtree(final)
+    os.rename(tmp, final)
+    fd = os.open(ckpt_dir, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+    if keep:
+        for s in _list_steps(ckpt_dir)[:-keep]:
+            shutil.rmtree(_step_dir(ckpt_dir, s), ignore_errors=True)
+    return final
+
+
+def _restore_dtype(arr: np.ndarray, stored: Optional[str]) -> np.ndarray:
+    """Re-view raw bytes as the recorded extension dtype (bfloat16/fp8 …)
+    when np.load degraded it to void."""
+    if stored is None or str(arr.dtype) == stored:
+        return arr
+    import ml_dtypes
+
+    if hasattr(ml_dtypes, stored):
+        return arr.view(np.dtype(getattr(ml_dtypes, stored)))
+    return arr.astype(stored)
+
+
+def restore_checkpoint(ckpt_dir: str, model=None,
+                       step: Optional[int] = None
+                       ) -> Tuple[int, Dict, Dict, Dict]:
+    """Load (step, params, state, opt_state).  With ``model`` given, params
+    and opt leaves are placed on the owning op's sharding and state on the
+    op's grid, exactly as ``FFModel.init`` would place them."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
+    d = _step_dir(ckpt_dir, step)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    stored_dtypes = meta.get("dtypes", {})
+    with np.load(os.path.join(d, "arrays.npz")) as z:
+        flat = {k: _restore_dtype(z[k], stored_dtypes.get(k))
+                for k in z.files}
+
+    trees = {"params": {}, "state": {}, "opt": {}}
+    for path, arr in flat.items():
+        tree_name, rest = path.split(_SEP, 1)
+        trees[tree_name][rest] = arr
+    params = _unflatten(trees["params"])
+    state = _unflatten(trees["state"])
+    opt_state = _unflatten(trees["opt"])
+
+    if model is not None:
+        import jax
+
+        shardings = {}
+        for op in model.layers:
+            if op.param_key not in shardings:
+                s = op.param_shardings(model.machine)
+                if s:
+                    shardings[op.param_key] = s
+
+        def place(tree):
+            placed = {}
+            for key, sub in tree.items():
+                ops_shard = shardings.get(key, {})
+                placed[key] = {
+                    k: jax.device_put(v, ops_shard[k]) if k in ops_shard
+                    else jax.device_put(v)
+                    for k, v in sub.items()
+                }
+            return placed
+
+        params = place(params)
+        opt_state = place(opt_state)
+        state = jax.tree.map(jax.device_put, state)
+    return step, params, state, opt_state
+
+
+def load_strategy(ckpt_dir: str, step: Optional[int] = None):
+    """The Strategy a checkpoint was trained under, or None."""
+    from flexflow_tpu.strategy import Strategy
+
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            return None
+    path = os.path.join(_step_dir(ckpt_dir, step), "strategy.json")
+    return Strategy.load(path) if os.path.exists(path) else None

@@ -135,7 +135,7 @@ def step_roofline(fn, *args, seconds_per_step: Optional[float] = None,
     perf = perf or TpuChipPerf()
     cost = compiled_cost(fn, *args)
     out = dict(cost)
-    out["model_flops_util_at_peak"] = (
+    out["min_step_seconds_at_peak"] = (
         cost["flops"] / perf.peak_flops if perf.peak_flops else 0.0)
     if seconds_per_step and seconds_per_step > 0:
         out["achieved_tflops"] = cost["flops"] / seconds_per_step / 1e12
