@@ -58,7 +58,7 @@ class FFModel:
         return pc
 
     def _add(self, op: Op) -> Tensor:
-        for t in (op.outputs if op.outputs else [op.output]):
+        for t in op.all_outputs():
             if any(s <= 0 for s in t.shape):
                 raise ValueError(
                     f"op {op.name!r} produces an empty tensor {t.shape} — "
@@ -208,9 +208,8 @@ class FFModel:
             xs = [values[t.tid] for t in op.inputs]
             res, st = op.forward(params.get(op.param_key, {}),
                                  state.get(op.name, {}), xs, train)
-            outs = op.outputs if op.outputs else [op.output]
             ys = res if isinstance(res, tuple) else (res,)
-            for t, y, spec in zip(outs, ys, op.output_specs()):
+            for t, y, spec in zip(op.all_outputs(), ys, op.output_specs()):
                 if multi and spec is not None:
                     y = lax.with_sharding_constraint(
                         y, self.machine.sharding(op.pc, op.AXIS_NAMES, spec))
@@ -301,13 +300,11 @@ class FFModel:
         import jax
 
         num_iterations = num_iterations or self.config.num_iterations
-        params, state = self.init()
-        opt_state = self.init_opt_state(params)
-        step = self.make_train_step()
 
         # checkpoint/resume (TPU-native addition; the reference can only
         # serialize the strategy, strategy.cc:62-86 — see utils/checkpoint)
         start_iter = 0
+        resumed = False
         ckpt_dir = getattr(self.config, "ckpt_dir", "")
         ckpt_freq = getattr(self.config, "ckpt_freq", 0)
         if ckpt_dir:
@@ -316,11 +313,22 @@ class FFModel:
             if ckpt.latest_step(ckpt_dir) is not None:
                 start_iter, params, state, opt_state = \
                     ckpt.restore_checkpoint(ckpt_dir, self)
+                resumed = True
+                opt_state = opt_state or self.init_opt_state(params)
+                saved = ckpt.load_strategy(ckpt_dir)
+                if saved is not None \
+                        and dict(saved) != dict(self.config.strategies):
+                    log("warning: checkpoint was trained under a different "
+                        "strategy; continuing under the current one")
                 log(f"resumed from {ckpt_dir} at iteration {start_iter}")
                 # re-align a deterministic (seeded) data stream with the
                 # restored position so resume matches the uninterrupted run
                 for _ in range(min(start_iter, num_iterations)):
                     next(data_iter)
+        if not resumed:
+            params, state = self.init()
+            opt_state = self.init_opt_state(params)
+        step = self.make_train_step()
         warmup = start_iter + min(warmup,
                                   max(num_iterations - start_iter - 1, 0))
 

@@ -104,6 +104,11 @@ class Op:
         """One spec per output (multi-output ops override)."""
         return [self.output_spec()]
 
+    def all_outputs(self) -> List[Tensor]:
+        """Every output tensor (the single ``output`` unless the op sets
+        ``outputs``)."""
+        return self.outputs if self.outputs else [self.output]
+
     def param_specs(self) -> Dict:
         """PartitionSpec per param leaf (same tree structure as
         init_params)."""
@@ -117,8 +122,7 @@ class Op:
         equivalent of the reference's disjoint/complete partition asserts
         (conv_2d.cu:108-109)."""
         sizes = dict(zip(self.AXIS_NAMES, self.pc.dims))
-        outs = self.outputs if self.outputs else [self.output]
-        for t, spec in zip(outs, self.output_specs()):
+        for t, spec in zip(self.all_outputs(), self.output_specs()):
             if spec is None:
                 continue
             for d, entry in enumerate(spec):

@@ -2,7 +2,10 @@
 collectives for sequence/context parallelism (capability extension over the
 reference, which has no attention at all — SURVEY.md §2.6 CP row)."""
 
+from flexflow_tpu.parallel.pipeline import (microbatch, spmd_pipeline,
+                                            transformer_block_fn)
 from flexflow_tpu.parallel.ring_attention import (blockwise_attention,
                                                   ring_attention)
 
-__all__ = ["blockwise_attention", "ring_attention"]
+__all__ = ["blockwise_attention", "microbatch", "ring_attention",
+           "spmd_pipeline", "transformer_block_fn"]

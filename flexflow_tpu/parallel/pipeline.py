@@ -1,0 +1,215 @@
+"""Pipeline parallelism: an explicit GPipe-style microbatch scheduler.
+
+The reference has NO pipeline scheduler — its "pipelining" is emergent:
+per-(layer, chunk) ops placed on different GPUs execute as a wavefront
+under Legion's async task graph (SURVEY.md §2.6 "PP de-facto",
+nmt/rnn.cu:298-326).  This module supplies the explicit capability,
+TPU-native:
+
+  * stages live on a named mesh axis (``stage``); each stage holds its own
+    slice of the stacked stage parameters (sharded over that axis);
+  * microbatches stream through the ring: every tick each device applies
+    its stage to its current activation, then ``ppermute`` rotates
+    activations one stage forward over neighbor ICI links;
+  * the schedule is GPipe (fill, steady state, drain): M microbatches over
+    S stages take M + S - 1 ticks with an S-1 bubble; backward is jax
+    autodiff through the scan + ppermute (the transpose of a shift is the
+    reverse shift), which interleaves into the same ring;
+  * composes with data parallelism: extra mesh axes (e.g. ``n``) shard the
+    microbatch batch dim; replicated-param cotangents are reduced by
+    shard_map's transpose machinery.
+
+All collectives are neighbor ppermutes — no all-to-all, no host round
+trips; exactly the layout "How to Scale Your Model" prescribes for
+pipelining on TPU meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def microbatch(x, num_microbatches: int):
+    """(B, ...) -> (M, B//M, ...) leading microbatch axis."""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches {num_microbatches}")
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params, xs, mesh: Mesh,
+                  stage_axis: str = "stage",
+                  batch_spec: Optional[P] = None):
+    """Run microbatches through a homogeneous pipeline of S stages.
+
+    stage_fn(params_one_stage, x_mb) -> y_mb; activations must keep the
+    same shape through every stage (the classic pipeline contract).
+
+    stage_params: pytree with a leading axis of size S (stage-stacked),
+    sharded over ``stage_axis``.  xs: (M, mb, ...) microbatched input.
+    batch_spec: PartitionSpec of one microbatch's data dims (after the
+    leading M axis), e.g. P("n") to shard the microbatch over a data
+    axis; defaults to fully replicated.
+
+    Returns (M, mb, ...) outputs, replicated over ``stage_axis``.
+    """
+    import inspect
+    try:
+        from jax import shard_map  # jax >= 0.8
+        rep_kw = {"check_vma": False} \
+            if "check_vma" in inspect.signature(shard_map).parameters \
+            else {"check_rep": False}
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+        rep_kw = {"check_rep": False}
+
+    s_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    num_stages = s_sizes[stage_axis]
+    num_mb = xs.shape[0]
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != num_stages:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != stage mesh "
+                f"axis size {num_stages}; each device must hold exactly "
+                f"one stage slice")
+    data_spec = batch_spec if batch_spec is not None else P()
+    xs_spec = P(None, *data_spec)   # leading M axis never sharded
+    param_spec = P(stage_axis)      # leading stage-stack axis
+
+    def pipelined(params, xs_local):
+        local_params = jax.tree.map(lambda p: p[0], params)
+        idx = lax.axis_index(stage_axis)
+        ticks = num_mb + num_stages - 1
+        zero = jnp.zeros(xs_local.shape[1:], xs_local.dtype)
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def tick(carry, t):
+            recv = carry
+            x_t = lax.dynamic_index_in_dim(
+                xs_local, jnp.clip(t, 0, num_mb - 1), 0, keepdims=False)
+            inp = jnp.where(idx == 0, x_t, recv)
+            y = stage_fn(local_params, inp)
+            recv_next = lax.ppermute(y, stage_axis, perm)
+            return recv_next, y
+
+        _, ys = lax.scan(tick, zero, jnp.arange(ticks))
+        # stage S-1 emits microbatch m at tick m + S - 1
+        out_local = lax.slice_in_dim(ys, num_stages - 1,
+                                     num_stages - 1 + num_mb, axis=0)
+        # broadcast the last stage's outputs to every stage (masked psum)
+        out = lax.psum(
+            jnp.where(idx == num_stages - 1, out_local,
+                      jnp.zeros_like(out_local)),
+            stage_axis)
+        return out
+
+    return shard_map(
+        pipelined, mesh=mesh,
+        in_specs=(param_spec, xs_spec),
+        out_specs=xs_spec,
+        **rep_kw,
+    )(stage_params, xs)
+
+
+def sequential_reference(stage_fn: Callable, stage_params, xs):
+    """Non-pipelined ground truth: apply the S stages in order to each
+    microbatch (used by tests to pin the pipeline's semantics)."""
+    num_stages = jax.tree.leaves(stage_params)[0].shape[0]
+
+    def apply_all(x_mb):
+        for s in range(num_stages):
+            p_s = jax.tree.map(lambda p: p[s], stage_params)
+            x_mb = stage_fn(p_s, x_mb)
+        return x_mb
+
+    return jax.vmap(apply_all)(xs)
+
+
+# ----------------------------------------------------------------------
+# pipelined transformer blocks (flagship integration)
+
+
+def _layer_norm(g, b, x, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+
+def transformer_block_fn(num_heads: int, causal: bool = False):
+    """A pre-norm transformer block as a pipeline stage_fn.  Params:
+    {"ln1": (2, D), "wqkv": (D, 3D), "bqkv": (3D,), "wo": (D, D),
+     "bo": (D,), "ln2": (2, D), "w1": (D, F), "b1": (F,), "w2": (F, D),
+     "b2": (D,)}."""
+
+    def block(p, x):
+        d = x.shape[-1]
+        h = _layer_norm(p["ln1"][0], p["ln1"][1], x)
+        qkv = h @ p["wqkv"] + p["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (B, S, D) -> (B, H, S, d_h)
+            b_, s_, _ = t.shape
+            return t.reshape(b_, s_, num_heads, d // num_heads) \
+                    .transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d // num_heads, x.dtype))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+            s = jnp.where(mask, s, -jnp.inf)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+        o = o.transpose(0, 2, 1, 3).reshape(x.shape)
+        x = x + (o @ p["wo"] + p["bo"])
+
+        h = _layer_norm(p["ln2"][0], p["ln2"][1], x)
+        h = jax.nn.gelu(h @ p["w1"] + p["b1"])
+        return x + (h @ p["w2"] + p["b2"])
+
+    return block
+
+
+def init_block_stack(rng, num_stages: int, d_model: int, d_ff: int):
+    """Stage-stacked transformer block params (leading axis = stage)."""
+    ks = jax.random.split(rng, 4)
+    shapes = {
+        "ln1": ((2, d_model), None),
+        "wqkv": ((d_model, 3 * d_model), 0),
+        "bqkv": ((3 * d_model,), None),
+        "wo": ((d_model, d_model), 1),
+        "bo": ((d_model,), None),
+        "ln2": ((2, d_model), None),
+        "w1": ((d_model, d_ff), 2),
+        "b1": ((d_ff,), None),
+        "w2": ((d_ff, d_model), 3),
+        "b2": ((d_model,), None),
+    }
+    params = {}
+    for name, (shape, ki) in shapes.items():
+        full = (num_stages,) + shape
+        if ki is None:
+            init = jnp.zeros(full, "float32")
+            if name.startswith("ln"):
+                init = init.at[:, 0].set(1.0)  # scale=1, bias=0
+            params[name] = init
+        else:
+            fan_in = shape[0]
+            params[name] = jax.random.normal(ks[ki], full, "float32") \
+                * (1.0 / jnp.sqrt(fan_in))
+    return params
+
+
+def place_stage_params(params, mesh: Mesh, stage_axis: str = "stage"):
+    """Shard the stage-stacked params over the stage axis of ``mesh``."""
+    return jax.tree.map(
+        lambda p: jax.device_put(
+            p, NamedSharding(mesh, P(*((stage_axis,) +
+                                       (None,) * (p.ndim - 1))))),
+        params)

@@ -54,7 +54,7 @@ class AnalyticCostModel:
         n_parts = pc.num_parts
         flops = shard_flops(op, pc)
         io_elems = sum(t.size() for t in op.inputs) + \
-            sum(t.size() for t in (op.outputs or [op.output]))
+            sum(t.size() for t in op.all_outputs())
         bytes_moved = 3.0 * 4.0 * io_elems / n_parts + op.param_bytes()
         p = self.perf
         eff = p.matmul_efficiency if type(op).__name__ in _MATMUL_OPS \

@@ -271,7 +271,7 @@ class StrategySearch:
         self.ops: List[Op] = list(model.layers)
         self._op_index = {}
         for i, op in enumerate(self.ops):
-            for t in (op.outputs or [op.output]):
+            for t in op.all_outputs():
                 self._op_index[t.tid] = i
         self.candidates: List[List[ParallelConfig]] = []
         self.sim: Optional[NativeSimulator] = None

@@ -95,8 +95,8 @@ def save_checkpoint(ckpt_dir: str, step: int, params: Dict, state: Dict,
 
     arrays: Dict[str, np.ndarray] = {}
     dtypes: Dict[str, str] = {}
-    for tree_name, tree in (("params", params), ("state", state),
-                            ("opt", opt_state)):
+    for tree_name, tree in (("params", params), ("state", state or {}),
+                            ("opt", opt_state or {})):
         for path, leaf in _flatten(tree, tree_name + _SEP).items():
             a = np.asarray(leaf)
             arrays[path] = a
@@ -123,14 +123,22 @@ def save_checkpoint(ckpt_dir: str, step: int, params: Dict, state: Dict,
             os.fsync(fd)
         finally:
             os.close(fd)
+    # never delete the old committed dir before the new one is in place:
+    # move it aside, rename tmp in, then drop the aside copy
+    aside = None
     if os.path.exists(final):
-        shutil.rmtree(final)
+        aside = final + ".old"
+        if os.path.exists(aside):
+            shutil.rmtree(aside)
+        os.rename(final, aside)
     os.rename(tmp, final)
     fd = os.open(ckpt_dir, os.O_RDONLY)
     try:
         os.fsync(fd)
     finally:
         os.close(fd)
+    if aside:
+        shutil.rmtree(aside, ignore_errors=True)
 
     if keep:
         for s in _list_steps(ckpt_dir)[:-keep]:

@@ -1,0 +1,132 @@
+"""Pipeline-parallel scheduler tests (parallel/pipeline.py).
+
+The invariant pinned here is the pipeline contract: GPipe microbatch
+streaming over the stage mesh axis computes EXACTLY what sequential stage
+application computes — forward and gradients — while composing with data
+parallelism on a second mesh axis."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from flexflow_tpu.parallel.pipeline import (init_block_stack, microbatch,
+                                            place_stage_params,
+                                            sequential_reference,
+                                            spmd_pipeline,
+                                            transformer_block_fn)
+
+
+def _mesh(stage, n):
+    devs = np.array(jax.devices()[:stage * n]).reshape(stage, n)
+    return Mesh(devs, ("stage", "n"))
+
+
+def _simple_stage(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _simple_params(rng, num_stages, d):
+    kw, = jax.random.split(rng, 1)
+    return {
+        "w": jax.random.normal(kw, (num_stages, d, d)) / np.sqrt(d),
+        "b": jnp.zeros((num_stages, d)),
+    }
+
+
+def test_pipeline_matches_sequential_forward():
+    mesh = _mesh(4, 2)
+    d, mb, M = 8, 4, 6
+    params = _simple_params(jax.random.PRNGKey(0), 4, d)
+    params = place_stage_params(params, mesh)
+    x = jax.random.normal(jax.random.PRNGKey(1), (M * mb, d))
+    xs = microbatch(x, M)
+
+    out = spmd_pipeline(_simple_stage, params, xs, mesh,
+                        batch_spec=P("n"))
+    ref = sequential_reference(_simple_stage, jax.device_get(params), xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    mesh = _mesh(4, 2)
+    d, mb, M = 8, 4, 4
+    params = _simple_params(jax.random.PRNGKey(2), 4, d)
+    x = jax.random.normal(jax.random.PRNGKey(3), (M * mb, d))
+    xs = microbatch(x, M)
+
+    def loss_pipe(p):
+        out = spmd_pipeline(_simple_stage, p, xs, mesh, batch_spec=P("n"))
+        return (out ** 2).sum()
+
+    def loss_seq(p):
+        return (sequential_reference(_simple_stage, p, xs) ** 2).sum()
+
+    g_pipe = jax.grad(loss_pipe)(place_stage_params(params, mesh))
+    g_seq = jax.grad(loss_seq)(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                   np.asarray(g_seq[k]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_microbatch_validation():
+    with pytest.raises(ValueError):
+        microbatch(jnp.ones((10, 3)), 4)
+
+
+def test_transformer_block_pipeline_matches_sequential():
+    mesh = _mesh(2, 4)
+    S, B, L, D, F, H = 2, 8, 6, 16, 32, 4
+    block = transformer_block_fn(num_heads=H, causal=True)
+    params = init_block_stack(jax.random.PRNGKey(4), S, D, F)
+    x = jax.random.normal(jax.random.PRNGKey(5), (B, L, D))
+    xs = microbatch(x, 2)
+
+    out = spmd_pipeline(block, place_stage_params(params, mesh), xs, mesh,
+                        batch_spec=P("n"))
+    ref = sequential_reference(block, params, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_pipelined_training_step_decreases_loss():
+    """End-to-end: embed -> pipelined blocks -> head, trained with SGD on a
+    fixed batch; loss must fall (autodiff through the full schedule)."""
+    mesh = _mesh(4, 2)
+    S, B, L, D, F, H, V, M = 4, 8, 6, 16, 32, 4, 64, 2
+    block = transformer_block_fn(num_heads=H, causal=True)
+
+    k = jax.random.PRNGKey(6)
+    k1, k2, k3, k4 = jax.random.split(k, 4)
+    params = {
+        "stack": place_stage_params(
+            init_block_stack(k1, S, D, F), mesh),
+        "embed": jax.random.normal(k2, (V, D)) * 0.02,
+        "head": jax.random.normal(k3, (D, V)) * 0.02,
+    }
+    tokens = jax.random.randint(k4, (B, L), 0, V)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    def loss_fn(p):
+        x = p["embed"][tokens]
+        xs = microbatch(x, M)
+        ys = spmd_pipeline(block, p["stack"], xs, mesh, batch_spec=P("n"))
+        logits = ys.reshape(B, L, D) @ p["head"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(
+            logp, labels[..., None], axis=-1).mean()
+
+    @jax.jit
+    def step(p):
+        l, g = jax.value_and_grad(loss_fn)(p)
+        return jax.tree.map(lambda a, b: a - 0.5 * b, p, g), l
+
+    p = params
+    losses = []
+    for _ in range(8):
+        p, l = step(p)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9, losses
