@@ -50,6 +50,12 @@ def parse_args(argv) -> RnnConfig:
             cfg.seed = int(val())
         elif a == "--strategy":
             strategy_file = val()
+        elif a == "--params-ones":
+            cfg.params_init = "ones"
+        elif a == "--print-intermediates":
+            cfg.print_intermediates = True
+        elif a == "--dry-compile":
+            cfg.dry_compile = True
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg

@@ -32,6 +32,11 @@ class FFConfig:
     synthetic_input: bool = True   # reference default when -d absent (README.md:68)
     dataset_path: str = ""
     strategy_file: str = ""
+    # Verification mechanisms (SURVEY.md §4 parity)
+    params_init: str = "default"   # "ones" = PARAMETER_ALL_ONES (conv_2d.cu:393-398)
+    print_intermediates: bool = False  # PRINT_INTERMEDIATE_RESULT (nmt/rnn.h:25)
+    dry_compile: bool = False      # DISABLE_COMPUTATION analog (ops.h:19):
+                                   # build+partition+compile, execute nothing
     # TPU-native additions
     compute_dtype: str = "float32"   # "bfloat16" for MXU-friendly training
     param_dtype: str = "float32"
@@ -103,5 +108,11 @@ class FFConfig:
                 cfg.input_width = int(val())
             elif a == "--classes":
                 cfg.num_classes = int(val())
+            elif a == "--params-ones":
+                cfg.params_init = "ones"
+            elif a == "--print-intermediates":
+                cfg.print_intermediates = True
+            elif a == "--dry-compile":
+                cfg.dry_compile = True
             # unknown flags are ignored, like the reference parser
         return cfg

@@ -35,6 +35,7 @@ from flexflow_tpu.ops import (Concat, Conv2D, Flat, Linear, Op, Pool2D,
 from flexflow_tpu.ops.norm import BatchNorm
 from flexflow_tpu.ops.pool import POOL_MAX
 from flexflow_tpu.strategy import ParallelConfig, validate_strategy
+from flexflow_tpu.utils.debug import print_tensor
 
 
 class FFModel:
@@ -156,30 +157,58 @@ class FFModel:
     # ------------------------------------------------------------------
     # parameters
 
-    def init(self, seed: Optional[int] = None):
+    def init(self, seed: Optional[int] = None, abstract: bool = False):
         """Initialize (params, state), placing each param with its op's
         sharding (reference: INIT_PARA tasks writing into replicated
-        regions, conv_2d.cu:374-419)."""
+        regions, conv_2d.cu:374-419).  With ``abstract=True`` the same
+        traversal yields sharding-annotated ShapeDtypeStructs and nothing
+        is materialized (used by the DISABLE_COMPUTATION-analog dry
+        compile)."""
         import jax
+        import jax.numpy as jnp
 
         seed = self.config.seed if seed is None else seed
         key = jax.random.PRNGKey(seed)
+        all_ones = self.config.params_init == "ones"
         params: Dict[str, Dict] = {}
         state: Dict[str, Dict] = {}
         for op in self.layers:
             if op.param_key not in params:
                 # shared weights: first op with the key initializes
                 key, sub = jax.random.split(key)
-                p = op.init_params(sub)
+                if abstract:
+                    try:
+                        p = jax.eval_shape(op.init_params, sub)
+                    except (jax.errors.TracerArrayConversionError,
+                            jax.errors.ConcretizationTypeError,
+                            jax.errors.TracerBoolConversionError):
+                        # init uses host-side (numpy) randomness —
+                        # materialize on host; genuine bugs still propagate
+                        p = op.init_params(sub)
+                else:
+                    p = op.init_params(sub)
+                    if p and all_ones:
+                        # PARAMETER_ALL_ONES parity (conv_2d.cu:393-398):
+                        # deterministic all-ones weights, hand-checkable runs
+                        p = {k: jnp.ones_like(v) for k, v in p.items()}
                 if p:
                     shardings = op.param_shardings(self.machine)
-                    params[op.param_key] = {
-                        k: jax.device_put(v, shardings[k])
-                        for k, v in p.items()
-                    }
+                    if abstract:
+                        params[op.param_key] = {
+                            k: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                                    sharding=shardings[k])
+                            for k, v in p.items()
+                        }
+                    else:
+                        params[op.param_key] = {
+                            k: jax.device_put(v, shardings[k])
+                            for k, v in p.items()
+                        }
             s = op.init_state()  # state is per-op even under shared params
             if s:
-                state[op.name] = s
+                state[op.name] = jax.tree.map(
+                    lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), s) \
+                    if abstract else s
         return params, state
 
     def init_opt_state(self, params):
@@ -202,6 +231,7 @@ class FFModel:
         from jax import lax
 
         multi = self.machine.num_devices > 1
+        dump = self.config.print_intermediates
         values: Dict[int, Any] = dict(inputs)
         new_state: Dict[str, Dict] = {}
         for op in self.layers:
@@ -213,6 +243,8 @@ class FFModel:
                 if multi and spec is not None:
                     y = lax.with_sharding_constraint(
                         y, self.machine.sharding(op.pc, op.AXIS_NAMES, spec))
+                if dump:
+                    print_tensor(f"{op.name}/{t.name or 'out'}", y)
                 values[t.tid] = y
             if st:
                 new_state[op.name] = st
@@ -272,6 +304,47 @@ class FFModel:
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
+    @staticmethod
+    def _lower_step(step, params, state, opt_state, batch):
+        import jax
+
+        abstract = [jax.ShapeDtypeStruct(b.shape, b.dtype,
+                                         sharding=getattr(b, "sharding",
+                                                          None))
+                    for b in batch]
+        return step.lower(params, state, opt_state, *abstract)
+
+    def abstract_train_state(self):
+        """(params, state, opt_state) as sharding-annotated
+        ShapeDtypeStructs — the avals ``init()`` would produce (same
+        traversal, ``abstract=True``) with nothing materialized."""
+        import jax
+
+        params, state = self.init(abstract=True)
+        # honor subclass init_opt_state overrides (e.g. plain-SGD models
+        # return None); re-attach param shardings when the trees mirror
+        opt_state = jax.eval_shape(self.init_opt_state, params)
+        try:
+            opt_state = jax.tree.map(
+                lambda o, p: jax.ShapeDtypeStruct(o.shape, o.dtype,
+                                                  sharding=p.sharding),
+                opt_state, params)
+        except ValueError:
+            pass
+        return params, state, opt_state
+
+    def compile_train_step(self, *batch):
+        """Compile (but do not run) the full training step — the
+        DISABLE_COMPUTATION analog (ops.h:19).  ``batch`` supplies the data
+        avals (arrays or ShapeDtypeStructs).  Nothing is materialized: the
+        train state enters lowering as sharded avals, so arbitrarily large
+        models compile-check on any machine.  Returns the compiled
+        executable (``.cost_analysis()``, ``.memory_analysis()``,
+        ``.as_text()`` for inspection)."""
+        params, state, opt_state = self.abstract_train_state()
+        return self._lower_step(self.make_train_step(), params, state,
+                                opt_state, batch).compile()
+
     def make_eval_step(self):
         import jax
         import jax.numpy as jnp
@@ -300,6 +373,24 @@ class FFModel:
         import jax
 
         num_iterations = num_iterations or self.config.num_iterations
+
+        if getattr(self.config, "dry_compile", False):
+            # DISABLE_COMPUTATION analog (ops.h:19): run the whole graph/
+            # partition/compile machinery — tracing, sharding propagation,
+            # SPMD partitioning, XLA compilation — but materialize and
+            # execute nothing (the train state enters lowering as avals).
+            from flexflow_tpu.utils.profiling import normalize_cost_analysis
+
+            compiled = self.compile_train_step(*next(data_iter))
+            cost = normalize_cost_analysis(compiled)
+            mem = compiled.memory_analysis()
+            log(f"dry-compile ok: {len(self.layers)} layers, "
+                f"flops/step = {cost.get('flops', 0.0):.3e}, "
+                f"argument bytes = "
+                f"{getattr(mem, 'argument_size_in_bytes', 0)}")
+            return {"params": None, "state": None, "loss": [],
+                    "elapsed_s": 0.0, "images_per_sec": 0.0,
+                    "compiled": compiled}
 
         # checkpoint/resume (TPU-native addition; the reference can only
         # serialize the strategy, strategy.cc:62-86 — see utils/checkpoint)

@@ -33,6 +33,10 @@ class TransformerConfig:
     num_iterations: int = 10
     compute_dtype: str = "float32"
     seed: int = 0
+    # verification mechanisms (forwarded to FFConfig; SURVEY.md §4)
+    params_init: str = "default"
+    print_intermediates: bool = False
+    dry_compile: bool = False
 
 
 class TransformerLM(FFModel):
@@ -51,6 +55,9 @@ class TransformerLM(FFModel):
             num_iterations=self.t.num_iterations,
             compute_dtype=self.t.compute_dtype,
             seed=self.t.seed,
+            params_init=self.t.params_init,
+            print_intermediates=self.t.print_intermediates,
+            dry_compile=self.t.dry_compile,
             strategies=strategies or Strategy(),
         )
         super().__init__(ff_cfg, machine)

@@ -52,6 +52,10 @@ class RnnConfig:
     num_iterations: int = 10
     compute_dtype: str = "float32"
     seed: int = 0
+    # verification mechanisms (forwarded to FFConfig; SURVEY.md §4)
+    params_init: str = "default"
+    print_intermediates: bool = False
+    dry_compile: bool = False
 
     @property
     def chunks_per_seq(self) -> int:
@@ -94,6 +98,9 @@ class RnnModel(FFModel):
             num_iterations=self.rnn.num_iterations,
             compute_dtype=self.rnn.compute_dtype,
             seed=self.rnn.seed,
+            params_init=self.rnn.params_init,
+            print_intermediates=self.rnn.print_intermediates,
+            dry_compile=self.rnn.dry_compile,
             strategies=strategies,
         )
         super().__init__(ff_cfg, machine)

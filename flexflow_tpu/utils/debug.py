@@ -1,0 +1,29 @@
+"""Debug-dump helpers — the reference's ``print_tensor`` (cuda_helper.h:67-84)
+and the ``PRINT_INTERMEDIATE_RESULT`` switch (nmt/rnn.h:25, used at
+nmt/rnn.cu:640-647 to dump per-step gradients).
+
+TPU-native design: tensors live sharded on device inside a jitted program, so
+the dump is a ``jax.debug.print`` — a host callback that works under jit,
+pjit, scan and across shardings (values are gathered for printing).  It
+prints shape plus summary stats rather than raw elements: at framework
+scale the statistics are the checkable signature of a tensor, and the full
+gather of a sharded activation would be the debug tool destroying the
+evidence.  Set ``FFConfig.print_intermediates`` (CLI
+``--print-intermediates``) to dump every op output.
+"""
+
+from __future__ import annotations
+
+
+def print_tensor(tag: str, x) -> None:
+    """Print shape + summary statistics of ``x`` from inside (or outside)
+    a jitted computation."""
+    import jax
+    import jax.numpy as jnp
+
+    xf = x.astype("float32")
+    jax.debug.print(
+        "{tag}: shape={shape} dtype={dtype} "
+        "mean={m:.6f} std={s:.6f} absmax={a:.6f}",
+        tag=tag, shape=str(tuple(x.shape)), dtype=str(x.dtype),
+        m=jnp.mean(xf), s=jnp.std(xf), a=jnp.max(jnp.abs(xf)))

@@ -111,15 +111,22 @@ class OpProfiler:
         return "\n".join(lines)
 
 
+def normalize_cost_analysis(compiled) -> Dict[str, float]:
+    """``Compiled.cost_analysis()`` as one flat dict (older jax returns one
+    dict per device program)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return ca or {}
+
+
 def compiled_cost(fn, *args) -> Dict[str, float]:
     """FLOPs / bytes for the COMPILED program (XLA cost analysis) — what the
     chip will actually run after fusion, per step."""
     import jax
 
     compiled = jax.jit(fn).lower(*args).compile()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, list):  # older jax returns one dict per device program
-        ca = ca[0] if ca else {}
+    ca = normalize_cost_analysis(compiled)
     return {
         "flops": float(ca.get("flops", 0.0)),
         "bytes_accessed": float(ca.get("bytes accessed", 0.0)),

@@ -1,0 +1,124 @@
+"""Verification-mechanism parity (SURVEY.md §4): PARAMETER_ALL_ONES,
+DISABLE_COMPUTATION, PRINT_INTERMEDIATE_RESULT / print_tensor."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.data import synthetic_batches
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+
+def _tiny(machine, **cfg_kw):
+    cfg = FFConfig(batch_size=8, input_height=16, input_width=16,
+                   num_iterations=2, print_freq=0, num_classes=8, **cfg_kw)
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((8, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.pool2d("pool1", t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc1", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff, cfg
+
+
+def test_params_all_ones(machine8):
+    """params_init='ones' = PARAMETER_ALL_ONES (conv_2d.cu:393-398):
+    every trainable leaf is exactly 1.0, runs are hand-checkable."""
+    ff, _ = _tiny(machine8, params_init="ones")
+    params, _ = ff.init()
+    leaves = jax.tree.leaves(params)
+    assert leaves, "no params initialized"
+    for leaf in leaves:
+        np.testing.assert_array_equal(np.asarray(leaf), 1.0)
+
+    # with all-ones weights + all-ones images the forward is deterministic
+    # across repeated builds (the reference's hand-checkable mode)
+    img = jnp.ones((8, 16, 16, 3), "float32")
+    lbl = jnp.ones((8,), "int32")
+    l1, _ = ff.loss_fn(params, {}, img, lbl)
+    ff2, _ = _tiny(machine8, params_init="ones")
+    p2, _ = ff2.init(seed=123)  # different seed must not matter
+    l2, _ = ff2.loss_fn(p2, {}, img, lbl)
+    assert float(l1) == float(l2)
+
+
+def test_dry_compile_runs_nothing(machine8):
+    """dry_compile = DISABLE_COMPUTATION (ops.h:19): the full partition +
+    compile machinery runs, zero training steps execute."""
+    ff, cfg = _tiny(machine8, dry_compile=True)
+    data = synthetic_batches(machine8, 8, 16, 16, num_classes=8,
+                             mode="random")
+    logs = []
+    res = ff.fit(data, log=logs.append)
+    assert res["loss"] == []          # nothing executed
+    assert res["images_per_sec"] == 0.0
+    assert res["compiled"] is not None
+    assert any("dry-compile ok" in m for m in logs)
+    # compiled artifact is inspectable (flops accounted)
+    from flexflow_tpu.utils.profiling import normalize_cost_analysis
+
+    cost = normalize_cost_analysis(res["compiled"])
+    assert cost.get("flops", 0) > 0
+
+
+def test_dry_compile_validates_partitioning(machine8):
+    """A hybrid strategy still goes through SPMD partitioning under
+    dry-compile — bad grids fail at build, good grids compile."""
+    s = Strategy()
+    s["conv1"] = ParallelConfig((2, 1, 1, 4), tuple(range(8)))
+    s["fc1"] = ParallelConfig((4, 2), tuple(range(8)))
+    ff, _ = _tiny(machine8, dry_compile=True, strategies=s)
+    data = synthetic_batches(machine8, 8, 16, 16, num_classes=8,
+                             mode="random")
+    res = ff.fit(data, log=lambda *a: None)
+    assert res["compiled"] is not None
+
+
+def test_compile_train_step_api(machine8):
+    ff, _ = _tiny(machine8)
+    compiled = ff.compile_train_step(
+        jax.ShapeDtypeStruct((8, 16, 16, 3), "float32"),
+        jax.ShapeDtypeStruct((8,), "int32"))
+    assert "fusion" in compiled.as_text() or compiled.as_text()
+
+
+def test_print_intermediates(machine8, capfd):
+    """print_intermediates = PRINT_INTERMEDIATE_RESULT (nmt/rnn.h:25):
+    every op output is dumped with shape + stats, from inside jit."""
+    ff, _ = _tiny(machine8, print_intermediates=True)
+    params, state = ff.init()
+    img = jnp.ones((8, 16, 16, 3), "float32")
+    lbl = jnp.ones((8,), "int32")
+    loss, _ = jax.jit(ff.loss_fn, static_argnames="train")(
+        params, state, img, lbl, train=True)
+    float(loss)
+    jax.effects_barrier()
+    out = capfd.readouterr().out
+    for op_name in ("conv1", "pool1", "flat", "fc1", "softmax"):
+        assert op_name in out, f"no dump for {op_name}: {out[:400]}"
+    assert "mean=" in out and "shape=(8," in out
+
+
+def test_nmt_app_dry_compile(machine8, capfd):
+    """The verification flags reach the NMT model (the reference's
+    PRINT_INTERMEDIATE_RESULT lives in nmt/, nmt/rnn.h:25)."""
+    from flexflow_tpu.apps import nmt
+
+    out = nmt.main(["-b", "8", "-l", "1", "-s", "4", "-h", "16", "-e", "16",
+                    "--vocab", "64", "--chunk", "2", "--dry-compile"])
+    assert out["loss"] == []
+    assert any("dry-compile ok" in line
+               for line in capfd.readouterr().out.splitlines())
+
+
+def test_print_tensor_helper(capfd):
+    from flexflow_tpu.utils.debug import print_tensor
+
+    print_tensor("t", jnp.arange(6.0).reshape(2, 3))
+    jax.effects_barrier()
+    out = capfd.readouterr().out
+    assert "shape=(2, 3)" in out and "mean=2.5" in out
