@@ -142,6 +142,14 @@ class FFModel:
             name, self._pc(name, 3), input, num_heads, causal,
             machine=self.machine))
 
+    def moe(self, name, input, num_experts, d_ff, top_k: int = 2,
+            capacity_factor: float = 2.0) -> Tensor:
+        from flexflow_tpu.ops.moe import MixtureOfExperts
+
+        return self._add(MixtureOfExperts(
+            name, self._pc(name, 3), input, num_experts, d_ff, top_k,
+            capacity_factor, machine=self.machine))
+
     def seq_linear(self, name, input, out_channels,
                    param_key: str = None) -> Tensor:
         from flexflow_tpu.ops.rnn_linear import RnnLinear

@@ -29,6 +29,14 @@ class TransformerConfig:
     d_ff: int = 3072
     vocab_size: int = 32768
     causal: bool = False           # True = GPT-style next-token LM
+    # Mixture-of-Experts (EP — new SOAP axis beyond the reference):
+    # num_experts > 0 replaces the dense FFN of every ``moe_every``-th
+    # block with a top-k-routed MoE (ops/moe.py)
+    num_experts: int = 0
+    moe_every: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 1e-2
     learning_rate: float = 1e-3
     num_iterations: int = 10
     compute_dtype: str = "float32"
@@ -71,15 +79,21 @@ class TransformerLM(FFModel):
                                         "int32", "labels")
         x = self.embed("embed", self.tokens, t.vocab_size, t.d_model)
         x = self.pos_embed("pos_embed", x)
+        self._moe_aux_tids = []
         for i in range(t.num_layers):
             h = self.layer_norm(f"blk{i}_ln1", x)
             h = self.attention(f"blk{i}_attn", h, t.num_heads,
                                causal=t.causal)
             x = self.add_seq(f"blk{i}_res1", x, h)
             h = self.layer_norm(f"blk{i}_ln2", x)
-            h = self.seq_linear(f"blk{i}_ff1", h, t.d_ff)
-            h = self._gelu(f"blk{i}_gelu", h)
-            h = self.seq_linear(f"blk{i}_ff2", h, t.d_model)
+            if t.num_experts > 0 and i % t.moe_every == 0:
+                h = self.moe(f"blk{i}_moe", h, t.num_experts, t.d_ff,
+                             t.moe_top_k, t.moe_capacity_factor)
+                self._moe_aux_tids.append(self.layers[-1].aux.tid)
+            else:
+                h = self.seq_linear(f"blk{i}_ff1", h, t.d_ff)
+                h = self._gelu(f"blk{i}_gelu", h)
+                h = self.seq_linear(f"blk{i}_ff2", h, t.d_model)
             x = self.add_seq(f"blk{i}_res2", x, h)
         x = self.layer_norm("final_ln", x)
         logits = self.seq_linear("lm_head", x, t.vocab_size)
@@ -98,7 +112,12 @@ class TransformerLM(FFModel):
         values, new_state = self.apply(params, state, inputs, train)
         op = self.loss_op
         total = op.loss(values[op.output.tid], values[op.labels_tensor.tid])
-        return total / (self.t.batch_size * self.t.seq_length), new_state
+        loss = total / (self.t.batch_size * self.t.seq_length)
+        if train:  # aux balance term is a training regularizer only;
+            # eval loss stays plain CE (comparable across configs)
+            for tid in getattr(self, "_moe_aux_tids", ()):
+                loss = loss + self.t.moe_aux_weight * values[tid]
+        return loss, new_state
 
     def make_train_step(self):
         return self.make_sgd_step(self.t.learning_rate)

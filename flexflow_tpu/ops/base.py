@@ -159,6 +159,12 @@ class Op:
         """Forward FLOPs per sample (fwd+bwd modeled as 3x by the sim)."""
         return 0.0
 
+    def shard_flops_fwd(self, pc: ParallelConfig):
+        """Forward FLOPs of ONE shard under ``pc``, for ops whose work does
+        not divide uniformly over the grid (terms sharded over different
+        axes).  None -> flops_per_sample * batch / num_parts."""
+        return None
+
     def param_bytes(self) -> int:
         return 0
 

@@ -1,0 +1,221 @@
+"""Mixture-of-Experts FFN with expert parallelism (EP).
+
+New capability beyond the reference (SURVEY.md §2.6 lists EP as absent —
+"No MoE anywhere"); this closes the one SOAP axis the reference never had.
+The op follows the same per-layer-strategy design as every other op: a
+3-D grid ('e', 'c', 'n') = experts x expert-hidden channels x batch, so a
+strategy file can place each MoE layer independently (pure EP, EP x TP,
+EP x DP, ...).
+
+TPU-native design (GShard/Switch-style dense dispatch):
+
+  * routing builds static-shaped dispatch/combine tensors (one-hot over a
+    fixed per-expert capacity) — no dynamic shapes, so XLA tiles every
+    einsum onto the MXU;
+  * the token->expert shuffle is the ``bsec,bsd->ebcd`` dispatch einsum
+    under an ('e','n') sharding constraint: GSPMD lowers the resharding
+    from batch-sharded tokens to expert-sharded slots as an all-to-all
+    over ICI — the hand-written NCCL a2a of GPU MoE frameworks;
+  * expert FFNs run as one batched einsum over the local experts
+    (weights sharded P('e', ..., 'c')), combining EP with the reference's
+    channel TP (linear.cu's c-axis) inside each expert;
+  * the auxiliary load-balancing loss (Switch Transformer eq. 4) is a
+    second op output; the model adds it to the objective.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.strategy import ParallelConfig
+
+
+class MixtureOfExperts(Op):
+    """Token-routed top-k MoE FFN on (batch, seq, d_model) tensors.
+
+    Outputs: [y (B,S,D), aux_loss ()].
+    """
+
+    AXIS_NAMES = ("e", "c", "n")
+
+    def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
+                 num_experts: int, d_ff: int, top_k: int = 2,
+                 capacity_factor: float = 2.0, machine=None):
+        super().__init__(name, pc, [input])
+        assert input.ndim == 3
+        b, s, d = input.shape
+        assert 1 <= top_k <= num_experts
+        self.num_experts = num_experts
+        self.d_ff = d_ff
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        # static per-expert slot count (GShard capacity); rounded up so the
+        # expected balanced load always fits
+        self.capacity = max(1, int(math.ceil(
+            capacity_factor * top_k * s / num_experts)))
+        self.d_model = d
+        self.machine = machine
+        self.output = Tensor(input.shape, input.dtype, self, name)
+        self.aux = Tensor((), "float32", self, f"{name}_aux")
+        self.outputs = [self.output, self.aux]
+
+    # ---- parameters ----------------------------------------------------
+
+    def init_params(self, rng) -> Dict:
+        import jax
+        import jax.numpy as jnp
+
+        e, d, f = self.num_experts, self.d_model, self.d_ff
+        keys = jax.random.split(rng, 3)
+        init = jax.nn.initializers.glorot_uniform(in_axis=-2, out_axis=-1)
+        return {
+            "wg": jax.random.normal(keys[0], (d, e), "float32") * 0.02,
+            "w1": init(keys[1], (e, d, f), "float32"),
+            "b1": jnp.zeros((e, f), "float32"),
+            "w2": init(keys[2], (e, f, d), "float32"),
+            "b2": jnp.zeros((e, d), "float32"),
+        }
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        # experts sharded over 'e' (EP); expert-hidden channels over 'c'
+        # (TP inside each expert); router replicated
+        return {"wg": P(None, None),
+                "w1": P("e", None, "c"), "b1": P("e", "c"),
+                "w2": P("e", "c", None), "b2": P("e", None)}
+
+    def output_specs(self) -> List:
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None, None), None]
+
+    def output_spec(self):
+        return self.output_specs()[0]
+
+    def validate_partitioning(self):
+        super().validate_partitioning()
+        pe, pc_, pn = self.pc.dims
+        if self.num_experts % pe:
+            raise ValueError(
+                f"op {self.name!r}: {self.num_experts} experts not divisible "
+                f"by expert-grid {pe}")
+        if self.d_ff % pc_:
+            raise ValueError(
+                f"op {self.name!r}: d_ff={self.d_ff} not divisible by "
+                f"channel-grid {pc_}")
+
+    # ---- compute -------------------------------------------------------
+
+    def _constrain(self, y, spec):
+        if self.machine is not None and self.machine.num_devices > 1:
+            from jax import lax
+
+            return lax.with_sharding_constraint(
+                y, self.machine.sharding(self.pc, self.AXIS_NAMES, spec))
+        return y
+
+    def _route(self, probs):
+        """Static-shaped top-k routing -> (dispatch, combine, aux).
+
+        dispatch (B,S,E,C): 0/1, token (b,s) occupies slot c of expert e.
+        combine  (B,S,E,C): dispatch weighted by renormalized gate prob.
+        Tokens beyond an expert's capacity are dropped for that expert
+        (their combine mass is lost — standard GShard semantics).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        b, s, e = probs.shape
+        c, k = self.capacity, self.top_k
+        top_p, top_i = jax.lax.top_k(probs, k)              # (B,S,k)
+        if k > 1:
+            top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+        # (k == 1 keeps the RAW gate prob — Switch Transformer semantics:
+        # a renormalized weight would be the constant 1.0 and sever the
+        # router's gradient from the task loss)
+        counts = jnp.zeros((b, e), "float32")
+        dispatch = jnp.zeros((b, s, e, c), "float32")
+        combine = jnp.zeros((b, s, e, c), "float32")
+        for i in range(k):                                   # k is tiny/static
+            oh = jax.nn.one_hot(top_i[:, :, i], e, dtype="float32")
+            # slot index: tokens before me routed here (this slot pass) +
+            # tokens already placed by higher-priority passes
+            pos = jnp.cumsum(oh, axis=1) - oh + counts[:, None, :]
+            keep = oh * (pos < c)
+            counts = counts + keep.sum(axis=1)
+            slot = keep[..., None] * jax.nn.one_hot(
+                pos.astype("int32"), c, dtype="float32")
+            dispatch = dispatch + slot
+            combine = combine + top_p[:, :, i][..., None, None] * slot
+        # Switch aux loss: E * sum_e f_e * P_e, f from top-1 assignments
+        f = jax.nn.one_hot(top_i[:, :, 0], e, dtype="float32").mean((0, 1))
+        aux = e * jnp.sum(f * probs.mean((0, 1)))
+        return dispatch, combine, aux
+
+    def forward(self, params, state, xs: List, train: bool):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        (x,) = xs
+        # routing in float32 (router numerics are precision-sensitive)
+        logits = jnp.einsum("bsd,de->bse", x.astype("float32"), params["wg"])
+        dispatch, combine, aux = self._route(
+            jax.nn.softmax(logits, axis=-1))
+        # token -> expert-slot shuffle; the 'e'-sharding constraint makes
+        # GSPMD emit the all-to-all over ICI
+        xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(x.dtype), x,
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+        xin = self._constrain(xin, P("e", "n", None, None))
+        h = jnp.einsum("ebcd,edf->ebcf", xin, params["w1"].astype(x.dtype),
+                       preferred_element_type=jnp.float32)
+        h = jax.nn.gelu(h + params["b1"][:, None, None, :]).astype(x.dtype)
+        h = self._constrain(h, P("e", "n", None, "c"))
+        yo = jnp.einsum("ebcf,efd->ebcd", h, params["w2"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+        yo = (yo + params["b2"][:, None, None, :]).astype(x.dtype)
+        yo = self._constrain(yo, P("e", "n", None, None))
+        # expert-slot -> token combine (the reverse all-to-all)
+        y = jnp.einsum("bsec,ebcd->bsd", combine, yo.astype("float32"),
+                       preferred_element_type=jnp.float32)
+        return (y.astype(x.dtype), aux), state
+
+    # ---- cost model ----------------------------------------------------
+
+    def local_clone(self, pc: ParallelConfig):
+        pe, pc_, pn = pc.dims
+        b, s, d = self.inputs[0].shape
+        if pe > 1 or pc_ > 1 or b % pn:
+            return None  # analytic fallback (flops/parts is exact for e/c)
+        t = Tensor((b // pn, s, d))
+        return MixtureOfExperts(self.name, ParallelConfig((1, 1, 1), (0,)),
+                                t, self.num_experts, self.d_ff, self.top_k,
+                                self.capacity_factor)
+
+    def flops_per_sample(self) -> float:
+        s, d, f = self.output.shape[1], self.d_model, self.d_ff
+        e, c = self.num_experts, self.capacity
+        # router + dispatch/combine einsums + expert FFNs over E*C slots
+        return (2.0 * s * d * e + 4.0 * s * e * c * d
+                + 4.0 * e * c * d * f)
+
+    def shard_flops_fwd(self, pc: ParallelConfig):
+        # The three terms shard over different axes: the router is
+        # replicated over (e, c); dispatch/combine shard over (e, n) only;
+        # the expert FFNs shard over all of (e, c, n).  A uniform
+        # flops/num_parts split would under-cost EP x TP grids.
+        pe, pcc, pn = pc.dims
+        b, s, d = self.inputs[0].shape
+        f, e, c = self.d_ff, self.num_experts, self.capacity
+        local_b = b / pn
+        router = 2.0 * s * d * e * local_b
+        shuffle = 4.0 * s * e * c * d * local_b / pe
+        ffn = 4.0 * e * c * d * f * local_b / (pe * pcc)
+        return router + shuffle + ffn
+
+    def param_bytes(self) -> int:
+        e, d, f = self.num_experts, self.d_model, self.d_ff
+        return 4 * (d * e + 2 * e * d * f + e * f + e * d)

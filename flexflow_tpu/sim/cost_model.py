@@ -31,13 +31,17 @@ class TpuChipPerf:
     step_overhead: float = 3.0e-6    # per-kernel launch/fusion overhead
 
 
-_MATMUL_OPS = {"Conv2D", "Linear", "LSTMChunk", "RnnLinear"}
+_MATMUL_OPS = {"Conv2D", "Linear", "LSTMChunk", "RnnLinear",
+               "MixtureOfExperts"}
 
 
 def shard_flops(op: Op, pc: ParallelConfig) -> float:
     """Modeled fwd+bwd FLOPs of ONE shard: 3x forward (two extra GEMMs per
     matmul in backward).  Single source of truth for the analytic cost model
     and the profiler's attribution table."""
+    custom = op.shard_flops_fwd(pc)
+    if custom is not None:
+        return 3.0 * custom
     batch = op.output.shape[0]
     return 3.0 * op.flops_per_sample() * batch / pc.num_parts
 

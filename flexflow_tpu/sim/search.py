@@ -171,6 +171,23 @@ def _point_geometry(op: Op, kind: str, dims, idx):
         tn, tl, td = op.inputs[0].shape
         return out, [_rect(_split(tn, pn, in_), _split(tl, ps, is_),
                            (0, td))]
+    if kind == "MixtureOfExperts":
+        pe, pcc, pn = dims
+        ie, ic, in_ = idx
+        n, l, d = op.output.shape
+        nlo, nhi = _split(n, pn, in_)
+        # The MoE output is n-sharded and replicated over (e, c); one
+        # representative point per n-shard carries the data (and consumes
+        # the input n-shard) — the internal token all-to-all rides ICI
+        # links, not producer->consumer edges (same treatment as ring
+        # attention above).
+        if ie == 0 and ic == 0:
+            out = _rect((nlo, nhi), (0, l), (0, d))
+            ins = [_rect((nlo, nhi), (0, l), (0, d))]
+        else:
+            out = _rect((nlo, nlo), (0, 0), (0, 0))
+            ins = [_rect((nlo, nlo), (0, 0), (0, 0))]
+        return out, ins
     if kind == "LSTMChunk":
         (pn,) = dims
         (in_,) = idx
@@ -214,6 +231,9 @@ def _axis_extents(op: Op) -> Dict[str, List[int]]:
     if kind == "MultiHeadAttention":
         n, l, d = op.output.shape
         return {"s": [l], "h": [op.num_heads, d], "n": [n]}
+    if kind == "MixtureOfExperts":
+        n = op.output.shape[0]
+        return {"e": [op.num_experts], "c": [op.d_ff], "n": [n]}
     return {"n": [op.output.shape[0]]}
 
 

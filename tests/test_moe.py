@@ -1,0 +1,199 @@
+"""Mixture-of-Experts / expert-parallelism tests: numeric equivalence with a
+dense FFN when experts are identical, aux-loss sanity, EP/TP/DP strategy
+invariance on the 8-device mesh, training, and search integration."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from flexflow_tpu.ops.base import Tensor
+from flexflow_tpu.ops.moe import MixtureOfExperts
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+
+def _moe_op(machine=None, b=4, s=16, d=8, e=4, f=16, k=2, cap=4.0,
+            pc=None):
+    t = Tensor((b, s, d))
+    pc = pc or ParallelConfig((1, 1, 1), (0,))
+    return MixtureOfExperts("moe", pc, t, e, f, top_k=k,
+                            capacity_factor=cap, machine=machine)
+
+
+def test_moe_matches_dense_when_experts_identical():
+    """With identical experts and no capacity drops, top-k gating weights
+    sum to 1, so the MoE output must equal the dense FFN."""
+    op = _moe_op(cap=8.0)  # capacity >= S: nothing dropped
+    params = op.init_params(jax.random.PRNGKey(0))
+    w1 = params["w1"][0]
+    w2 = params["w2"][0]
+    params = dict(params,
+                  w1=jnp.broadcast_to(w1, params["w1"].shape),
+                  w2=jnp.broadcast_to(w2, params["w2"].shape))
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16, 8), jnp.float32)
+    (y, aux), _ = op.forward(params, {}, [x], train=True)
+    dense = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, w1)) @ w2
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=1e-4, atol=1e-5)
+    assert np.isfinite(float(aux))
+
+
+def test_moe_aux_loss_uniform_router():
+    """Uniform router logits -> P_e = 1/E and aux = E * sum_e f_e / E = 1
+    regardless of how ties are broken."""
+    op = _moe_op()
+    params = op.init_params(jax.random.PRNGKey(1))
+    params = dict(params, wg=jnp.zeros_like(params["wg"]))
+    x = jnp.asarray(np.random.RandomState(1).randn(4, 16, 8), jnp.float32)
+    (_, aux), _ = op.forward(params, {}, [x], train=True)
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-5)
+
+
+def test_moe_capacity_drops_tokens():
+    """A tiny capacity forces drops: total combine mass < number of
+    token-slots, and the op still runs finite."""
+    op = _moe_op(cap=0.25, k=1)
+    assert op.capacity < 16 // 4
+    params = op.init_params(jax.random.PRNGKey(2))
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 16, 8), jnp.float32)
+    (y, aux), _ = op.forward(params, {}, [x], train=True)
+    assert np.isfinite(np.asarray(y)).all() and np.isfinite(float(aux))
+    dispatch, combine, _ = op._route(
+        jax.nn.softmax(jnp.einsum("bsd,de->bse", x, params["wg"]), -1))
+    assert float(dispatch.sum()) <= 4 * 4 * op.capacity  # B * E * C slots
+
+
+def test_moe_top1_router_gets_task_gradient():
+    """With top_k=1 the combine weight must be the RAW gate prob (Switch
+    semantics): the router has to receive gradient from the main loss, not
+    only from the aux term."""
+    op = _moe_op(k=1, cap=8.0)
+    params = op.init_params(jax.random.PRNGKey(3))
+    x = jnp.asarray(np.random.RandomState(3).randn(4, 16, 8), jnp.float32)
+
+    def main_loss(wg):
+        (y, _), _ = op.forward(dict(params, wg=wg), {}, [x], train=True)
+        return (y ** 2).sum()
+
+    g = jax.grad(main_loss)(params["wg"])
+    assert float(jnp.abs(g).max()) > 1e-6, "router cut off from task loss"
+
+
+def test_moe_eval_loss_excludes_aux(machine8):
+    """loss_fn(train=False) must be plain CE — no aux regularizer."""
+    m = _moe_lm(machine8)
+    params, state = m.init()
+    toks = _tokens(machine8)
+    train_loss, _ = m.loss_fn(params, state, toks, toks, train=True)
+    eval_loss, _ = m.loss_fn(params, state, toks, toks, train=False)
+    assert float(train_loss) > float(eval_loss)  # aux > 0 always
+
+
+def test_moe_shard_flops_not_uniform():
+    """Router + dispatch terms do not shard over 'c': a (1,4,1) TP grid must
+    be costed at MORE than 1/4 of the total flops."""
+    from flexflow_tpu.sim.cost_model import shard_flops
+
+    op = _moe_op()
+    total = shard_flops(op, ParallelConfig((1, 1, 1), (0,)))
+    tp4 = shard_flops(op, ParallelConfig((1, 4, 1), tuple(range(4))))
+    assert tp4 > total / 4 * 1.05
+    # pure EP shards router only partially too, but more than TP does
+    ep4 = shard_flops(op, ParallelConfig((4, 1, 1), tuple(range(4))))
+    assert total / 4 < ep4 < tp4
+
+
+def test_moe_validates_grid():
+    with pytest.raises(ValueError, match="experts not divisible"):
+        _moe_op(e=4, pc=ParallelConfig((8, 1, 1),
+                                       tuple(range(8)))).validate_partitioning()
+    with pytest.raises(ValueError, match="not divisible by"):
+        _moe_op(f=6, pc=ParallelConfig((1, 4, 1),
+                                       tuple(range(4)))).validate_partitioning()
+
+
+def _moe_lm(machine, strategies=None, **overrides):
+    kw = dict(batch_size=8, seq_length=16, num_layers=2, d_model=32,
+              num_heads=4, d_ff=64, vocab_size=64, causal=True,
+              num_experts=4, moe_top_k=2, moe_capacity_factor=4.0,
+              learning_rate=1e-2, seed=11)
+    kw.update(overrides)
+    return TransformerLM(TransformerConfig(**kw), machine, strategies)
+
+
+def _tokens(machine, b=8, s=16, vocab=64, seed=3):
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.RandomState(seed)
+    n = machine.num_devices
+    sh = machine.sharding(ParallelConfig((n,), tuple(range(n))), ("n",),
+                          P("n"))
+    return jax.device_put(rng.randint(0, vocab, (b, s)).astype("int32"), sh)
+
+
+def test_moe_transformer_trains(machine8):
+    m = _moe_lm(machine8)
+    assert any(type(op).__name__ == "MixtureOfExperts" for op in m.layers)
+    params, state = m.init()
+    step = m.make_train_step()
+    toks = _tokens(machine8)
+    losses = []
+    for _ in range(6):
+        params, state, _, loss = step(params, state, None, toks, toks)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_moe_ep_strategy_invariance(machine8):
+    """Same seed and data: pure DP, pure EP, and EP x TP x DP hybrid grids
+    must produce the same loss trajectory (the FlexFlow invariant, now on
+    the expert axis)."""
+    def run(strategies):
+        m = _moe_lm(machine8, strategies)
+        params, state = m.init()
+        step = m.make_train_step()
+        toks = _tokens(machine8)
+        out = []
+        for _ in range(3):
+            params, state, _, loss = step(params, state, None, toks, toks)
+            out.append(float(loss))
+        return out
+
+    base = run(None)
+    devs = tuple(range(8))
+    ep = Strategy()
+    ep["blk0_moe"] = ParallelConfig((4, 1, 2), devs)    # EP x DP
+    ep["blk1_moe"] = ParallelConfig((4, 1, 2), devs)
+    got = run(ep)
+    np.testing.assert_allclose(base, got, rtol=3e-4, atol=3e-5)
+
+    hybrid = Strategy()
+    hybrid["blk0_moe"] = ParallelConfig((2, 2, 2), devs)  # EP x TP x DP
+    hybrid["blk1_moe"] = ParallelConfig((1, 4, 2), devs)  # TP x DP
+    got = run(hybrid)
+    np.testing.assert_allclose(base, got, rtol=3e-4, atol=3e-5)
+
+
+def test_moe_search_integration(machine8):
+    """The strategy search enumerates EP grids for MoE ops and returns an
+    executable strategy."""
+    from flexflow_tpu.sim import StrategySearch
+
+    m = _moe_lm(machine8)
+    search = StrategySearch(m, machine8)
+    moe_idx = [i for i, op in enumerate(m.layers)
+               if type(op).__name__ == "MixtureOfExperts"][0]
+    cands = search.candidates[moe_idx]
+    assert any(pc.dims[0] > 1 for pc in cands), "no EP candidates generated"
+    strategy, info = search.search(iters=1500, seed=7)
+    assert info["best_time"] <= search.simulate(search.dp_assignment()) + 1e-12
+    m2 = _moe_lm(machine8, strategy)
+    params, state = m2.init()
+    step = m2.make_train_step()
+    toks = _tokens(machine8)
+    _, _, _, loss = step(params, state, None, toks, toks)
+    assert np.isfinite(float(loss))
