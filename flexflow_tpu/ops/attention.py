@@ -3,8 +3,13 @@
 parallelism).
 
 Execution paths:
-  * s-parts == 1: blockwise (flash-style streaming-softmax) attention in
-    plain XLA; head/batch sharding handled by GSPMD from the specs.
+  * s-parts == 1 on TPU: the hand-written Pallas flash kernel
+    (ops/pallas/flash_attention.py) — scores stay in VMEM, blocks stream
+    through the MXU; multi-device grids run it per-shard under shard_map
+    (head/batch sharding is embarrassingly parallel).
+  * s-parts == 1 elsewhere (or shapes the kernel can't shard): blockwise
+    (flash-style streaming-softmax) attention in plain XLA; head/batch
+    sharding handled by GSPMD from the specs.
   * s-parts > 1 on a canonical full-device grid: explicit ring attention
     (shard_map + ppermute over the 's' mesh axis, see
     parallel/ring_attention.py) — K/V blocks rotate on neighbor links, O(S/P)
@@ -73,8 +78,7 @@ class MultiHeadAttention(Op):
     def forward(self, params, state, xs: List, train: bool):
         import jax.numpy as jnp
 
-        from flexflow_tpu.parallel.ring_attention import (
-            blockwise_attention, ring_attention)
+        from flexflow_tpu.parallel.ring_attention import ring_attention
 
         (x,) = xs
         b, s, d = x.shape
@@ -90,12 +94,42 @@ class MultiHeadAttention(Op):
             mesh = self.machine.mesh_for(self.pc, self.AXIS_NAMES)
             out = ring_attention(q, k, v, mesh, "s", self.causal)
         else:
-            out = blockwise_attention(q, k, v, self.causal,
-                                      block_size=min(s, 512))
+            out = self._flash_or_blockwise(q, k, v, s)
         out = out.astype(x.dtype).transpose(0, 2, 1, 3).reshape(b, s, d)
         y = jnp.einsum("bsd,de->bse", out, params["wo"].astype(x.dtype),
                        preferred_element_type=jnp.float32).astype(x.dtype)
         return y + params["bo"].astype(x.dtype), state
+
+    def _flash_or_blockwise(self, q, k, v, s: int):
+        """Non-ring attention body: the Pallas flash kernel on TPU (direct
+        on one device; per-shard under shard_map on a canonical multi-device
+        grid, where head/batch sharding is embarrassingly parallel),
+        otherwise the XLA streaming-softmax path with GSPMD sharding."""
+        from flexflow_tpu.ops.pallas import flash_attention, flash_enabled
+        from flexflow_tpu.parallel.ring_attention import blockwise_attention
+
+        if flash_enabled():
+            nd = self.machine.num_devices if self.machine is not None else 1
+            if nd == 1 or len(self.pc.devices) == 1:
+                return flash_attention(q, k, v, self.causal)
+            _, ph, pn = self.pc.dims
+            b, h = q.shape[0], q.shape[1]
+            if (self.machine.is_canonical(self.pc)
+                    and b % max(pn, 1) == 0 and h % max(ph, 1) == 0):
+                from jax.sharding import PartitionSpec as P
+
+                from flexflow_tpu.parallel.ring_attention import \
+                    unchecked_shard_map
+
+                mesh = self.machine.mesh_for(self.pc, self.AXIS_NAMES)
+                spec = P("n" if pn > 1 else None, "h" if ph > 1 else None,
+                         None, None)
+                return unchecked_shard_map(
+                    lambda ql, kl, vl: flash_attention(ql, kl, vl,
+                                                       self.causal),
+                    mesh, (spec, spec, spec), spec)(q, k, v)
+        return blockwise_attention(q, k, v, self.causal,
+                                   block_size=min(s, 512))
 
     def local_clone(self, pc: ParallelConfig):
         ps, ph, pn = pc.dims

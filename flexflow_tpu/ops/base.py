@@ -155,6 +155,13 @@ class Op:
 
     # ---- cost model hooks (consumed by the simulator) ------------------
 
+    def cost_signature(self) -> tuple:
+        """Extra compute-determining hyperparameters that do NOT appear in
+        input/output shapes (e.g. MoE expert count / hidden width).  Folded
+        into MeasuredCostModel's cache key so ops with identical shapes but
+        different internal work are never conflated."""
+        return ()
+
     def flops_per_sample(self) -> float:
         """Forward FLOPs per sample (fwd+bwd modeled as 3x by the sim)."""
         return 0.0

@@ -216,6 +216,10 @@ class MixtureOfExperts(Op):
         ffn = 4.0 * e * c * d * f * local_b / (pe * pcc)
         return router + shuffle + ffn
 
+    def cost_signature(self) -> tuple:
+        # expert work is invisible in the (B,S,D) input/output shapes
+        return (self.num_experts, self.d_ff, self.top_k, self.capacity)
+
     def param_bytes(self) -> int:
         e, d, f = self.num_experts, self.d_model, self.d_ff
         return 4 * (d * e + 2 * e * d * f + e * f + e * d)

@@ -1,0 +1,33 @@
+"""Pallas TPU kernels for the hot ops.
+
+These are the hand-scheduled compute paths of the framework (the analog of
+the reference's hand-written CUDA kernels, e.g. nmt/embed.cu's gather /
+scatter-add and the cuDNN leaf tasks): XLA fuses most elementwise work into
+the MXU matmuls on its own, so Pallas is reserved for the ops where manual
+VMEM tiling beats the compiler — attention's O(S^2) score matrix, which a
+flash kernel never materializes in HBM.
+
+Kernels run compiled (Mosaic) on TPU and in interpreter mode elsewhere, so
+the same code path is exercised by the CPU test suite.
+"""
+
+import os
+
+from flexflow_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def flash_enabled() -> bool:
+    """Policy gate for the flash kernel: on by default on TPU (compiled via
+    Mosaic), off elsewhere (interpret mode is for tests, too slow for
+    training).  FLEXFLOW_TPU_FLASH=0/1 overrides."""
+    env = os.environ.get("FLEXFLOW_TPU_FLASH", "").lower()
+    if env in ("0", "false"):
+        return False
+    if env in ("1", "true"):
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+__all__ = ["flash_attention", "flash_enabled"]

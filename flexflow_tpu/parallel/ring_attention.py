@@ -78,16 +78,10 @@ def blockwise_attention(q, k, v, causal: bool = False,
     return acc / l[..., None]
 
 
-def ring_attention(q, k, v, mesh, seq_axis: str, causal: bool = False):
-    """Ring attention under shard_map.
-
-    q,k,v: GLOBAL (B, H, S, d) arrays; ``mesh`` must contain ``seq_axis``
-    (sequence shards) — other mesh axes may shard batch/heads and are passed
-    through untouched.  Returns global (B, H, S, d) float32.
-    """
+def unchecked_shard_map(f, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across jax versions (the
+    kw was renamed check_rep -> check_vma around jax 0.8)."""
     import inspect
-
-    from jax.sharding import PartitionSpec as P
 
     try:
         from jax import shard_map  # jax >= 0.8
@@ -97,6 +91,18 @@ def ring_attention(q, k, v, mesh, seq_axis: str, causal: bool = False):
     except ImportError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map
         _check_kw = "check_rep"
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **{_check_kw: False})
+
+
+def ring_attention(q, k, v, mesh, seq_axis: str, causal: bool = False):
+    """Ring attention under shard_map.
+
+    q,k,v: GLOBAL (B, H, S, d) arrays; ``mesh`` must contain ``seq_axis``
+    (sequence shards) — other mesh axes may shard batch/heads and are passed
+    through untouched.  Returns global (B, H, S, d) float32.
+    """
+    from jax.sharding import PartitionSpec as P
 
     axes = dict(mesh.shape)
     p = axes[seq_axis]
@@ -135,5 +141,4 @@ def ring_attention(q, k, v, mesh, seq_axis: str, causal: bool = False):
         l = jnp.maximum(l, 1e-30)
         return acc / l[..., None]
 
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, **{_check_kw: False})(q, k, v)
+    return unchecked_shard_map(local, mesh, (spec, spec, spec), spec)(q, k, v)

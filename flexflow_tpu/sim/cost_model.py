@@ -113,7 +113,9 @@ class MeasuredCostModel:
 
     def _key(self, op: Op, pc: ParallelConfig) -> str:
         shapes = [t.shape for t in op.inputs] + [op.output.shape]
-        return f"{type(op).__name__}|{shapes}|{pc.dims}"
+        sig = op.cost_signature()
+        extra = f"|{sig}" if sig else ""
+        return f"{type(op).__name__}|{shapes}|{pc.dims}{extra}"
 
     def _measure(self, op: Op, pc: ParallelConfig) -> Optional[float]:
         import jax
