@@ -278,10 +278,17 @@ def _should_interpret() -> bool:
 
 @functools.lru_cache(maxsize=None)
 def _make_flash(q_shape, k_shape, qdt, kdt, vdt, causal, block_q, block_k,
-                interpret):
+                interpret, with_lse=False):
     """Build a custom-VJP flash op specialized for one static configuration
     (shapes/dtypes/blocks are Python constants closed over by the kernels;
-    the VJP residuals are pure arrays)."""
+    the VJP residuals are pure arrays).
+
+    With ``with_lse`` the op returns ``(out, lse)`` — the *partial*
+    attention form used by ring/context parallelism, where per-chunk
+    results are merged by log-sum-exp weighting.  The lse cotangent folds
+    into the backward kernels for free: d lse/d s_ij = p_ij, so passing
+    ``delta - g_lse`` where the kernels expect ``delta`` yields
+    ds = p (dp - delta + g_lse) — no kernel changes."""
     b, h, sq, d = q_shape
     sk = k_shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -303,17 +310,12 @@ def _make_flash(q_shape, k_shape, qdt, kdt, vdt, causal, block_q, block_k,
         x = x.reshape(b * h, x.shape[2], d)
         return jnp.pad(x, ((0, 0), (0, s_p - x.shape[1]), (0, d_p - d)))
 
-    @jax.custom_vjp
-    def flash(q, k, v):
-        out, _ = flash_fwd(q, k, v)
-        return out
-
-    def flash_fwd(q, k, v):
+    def run_fwd(q, k, v):
         qp, kp, vp = prep(q, sq_p), prep(k, sk_p), prep(v, sk_p)
         out, lse = _fwd_call(qp, kp, vp, scale, causal, sk, bq, bk, interpret)
-        return out[:, :sq, :d].reshape(b, h, sq, d), (qp, kp, vp, lse, out)
+        return out, lse, (qp, kp, vp, lse, out)
 
-    def flash_bwd(res, g):
+    def run_bwd(res, g, g_lse=None):
         qp, kp, vp, lse, out = res
         do = jnp.pad(g.astype(jnp.float32).reshape(b * h, sq, d),
                      ((0, 0), (0, sq_p - sq), (0, d_p - d)))
@@ -321,14 +323,52 @@ def _make_flash(q_shape, k_shape, qdt, kdt, vdt, causal, block_q, block_k,
         # delta is zero on padded Q rows (do = 0 there), so they contribute
         # nothing to dk/dv even though their lse is arbitrary
         delta = jnp.sum(do * out, axis=-1, keepdims=True)
+        if g_lse is not None:
+            glse_p = jnp.pad(g_lse.astype(jnp.float32).reshape(b * h, sq, 1),
+                             ((0, 0), (0, sq_p - sq), (0, 0)))
+            delta = delta - glse_p  # ds = p (dp - delta + g_lse)
         dq, dk, dv = _bwd_call(qp, kp, vp, do_k, lse, delta, scale, causal,
                                sk, bq, bk, interpret)
         return (dq[:, :sq, :d].reshape(b, h, sq, d).astype(qdt),
                 dk[:, :sk, :d].reshape(b, h, sk, d).astype(kdt),
                 dv[:, :sk, :d].reshape(b, h, sk, d).astype(vdt))
 
-    flash.defvjp(flash_fwd, flash_bwd)
-    return flash
+    if not with_lse:
+
+        @jax.custom_vjp
+        def flash(q, k, v):
+            out, _, _ = run_fwd(q, k, v)
+            return out[:, :sq, :d].reshape(b, h, sq, d)
+
+        def flash_fwd(q, k, v):
+            out, _, res = run_fwd(q, k, v)
+            return out[:, :sq, :d].reshape(b, h, sq, d), res
+
+        def flash_bwd(res, g):
+            return run_bwd(res, g)
+
+        flash.defvjp(flash_fwd, flash_bwd)
+        return flash
+
+    def unpack(out, lse):
+        return (out[:, :sq, :d].reshape(b, h, sq, d),
+                lse[:, :sq, 0].reshape(b, h, sq))
+
+    @jax.custom_vjp
+    def flash_p(q, k, v):
+        out, lse, _ = run_fwd(q, k, v)
+        return unpack(out, lse)
+
+    def flash_p_fwd(q, k, v):
+        out, lse, res = run_fwd(q, k, v)
+        return unpack(out, lse), res
+
+    def flash_p_bwd(res, gs):
+        g, g_lse = gs
+        return run_bwd(res, g, g_lse)
+
+    flash_p.defvjp(flash_p_fwd, flash_p_bwd)
+    return flash_p
 
 
 def flash_attention(q, k, v, causal=False, block_q=DEFAULT_BLOCK,
@@ -340,3 +380,35 @@ def flash_attention(q, k, v, causal=False, block_q=DEFAULT_BLOCK,
                     k.dtype.name, v.dtype.name, bool(causal), block_q,
                     block_k, interpret)
     return f(q, k, v)
+
+
+def flash_attention_partial(q, k, v, causal=False, block_q=DEFAULT_BLOCK,
+                            block_k=DEFAULT_BLOCK, interpret=None):
+    """Partial attention over one K/V chunk: returns ``(out, lse)`` where
+    ``out`` is the chunk-normalized attention and ``lse`` (B, H, Sq) the
+    log-sum-exp of its scores.  Chunks merge exactly via
+    :func:`combine_partials` — the building block of the Pallas ring-
+    attention path (each ring step attends Q against the resident K/V
+    block, then results merge by lse weight).  Differentiable in both
+    outputs."""
+    interpret = _should_interpret() if interpret is None else interpret
+    f = _make_flash(tuple(q.shape), tuple(k.shape), q.dtype.name,
+                    k.dtype.name, v.dtype.name, bool(causal), block_q,
+                    block_k, interpret, with_lse=True)
+    return f(q, k, v)
+
+
+def combine_partials(o1, lse1, o2, lse2):
+    """Merge two chunk-normalized partial attentions by log-sum-exp weight:
+    softmax over the union of their key sets.  Fully-masked partials
+    (lse = -inf, o = 0) drop out; if both are masked the result is 0."""
+    m = jnp.maximum(lse1, lse2)
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.where(jnp.isfinite(lse1), jnp.exp(lse1 - safe_m), 0.0)
+    w2 = jnp.where(jnp.isfinite(lse2), jnp.exp(lse2 - safe_m), 0.0)
+    tot = w1 + w2
+    lse = jnp.where(tot > 0, safe_m + jnp.log(jnp.maximum(tot, 1e-30)),
+                    _NEG_INF)
+    denom = jnp.maximum(tot, 1e-30)[..., None]
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / denom
+    return o, lse

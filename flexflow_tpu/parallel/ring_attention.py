@@ -114,31 +114,89 @@ def ring_attention(q, k, v, mesh, seq_axis: str, causal: bool = False):
     h_ax = "h" if "h" in axes and axes["h"] > 1 else None
     spec = P(n_ax, h_ax, seq_axis, None)
 
+    from flexflow_tpu.ops.pallas import flash_enabled
+
+    use_flash = flash_enabled()
+
+    def ring_kv(kl, vl, state, attend_step):
+        """The ring protocol, shared by both bodies: K/V chunks rotate to
+        the next neighbor each step; ``attend_step(t, kb, vb, state)``
+        folds the resident chunk into the running state."""
+        perm = [(i, (i + 1) % p) for i in range(p)]
+
+        def step(carry, t):
+            kb, vb, state = carry
+            state = attend_step(t, kb, vb, state)
+            kb = lax.ppermute(kb, seq_axis, perm)
+            vb = lax.ppermute(vb, seq_axis, perm)
+            return (kb, vb, state), 0.0
+
+        (_, _, state), _ = lax.scan(step, (kl, vl, state), jnp.arange(p))
+        return state
+
+    def local_flash(ql, kl, vl):
+        """Ring step body on the Pallas kernel: each step attends Q against
+        the resident K/V chunk via flash_attention_partial and merges by
+        log-sum-exp weight.  Causal masking never needs chunk offsets: a
+        step is either fully visible (source chunk strictly behind this
+        device's queries -> plain attention), diagonal (same chunk ->
+        plain causal), or fully hidden (skip) — so the kernels stay
+        offset-free and static."""
+        from flexflow_tpu.ops.pallas.flash_attention import (
+            combine_partials, flash_attention_partial)
+
+        idx = lax.axis_index(seq_axis)
+        b, h, sq, d = ql.shape
+
+        def attend(t, kb, vb, state):
+            o, lse = state
+            src = (idx - t) % p  # whose chunk we currently hold
+            if causal:
+                def full_fn(args):
+                    return flash_attention_partial(*args, causal=False)
+
+                def diag_fn(args):
+                    return flash_attention_partial(*args, causal=True)
+
+                def masked_fn(args):
+                    return (jnp.zeros((b, h, sq, d), jnp.float32),
+                            jnp.full((b, h, sq), -jnp.inf, jnp.float32))
+
+                branch = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+                o_t, lse_t = lax.switch(branch, [full_fn, diag_fn, masked_fn],
+                                        (ql, kb, vb))
+            else:
+                o_t, lse_t = flash_attention_partial(ql, kb, vb, causal=False)
+            return combine_partials(o, lse, o_t, lse_t)
+
+        o, _ = ring_kv(kl, vl,
+                       (jnp.zeros((b, h, sq, d), jnp.float32),
+                        jnp.full((b, h, sq), -jnp.inf, jnp.float32)),
+                       attend)
+        return o
+
     def local(ql, kl, vl):
         s_local = ql.shape[2]
         idx = lax.axis_index(seq_axis)
         b, h, sq, d = ql.shape
-        m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
-        l = jnp.zeros((b, h, sq), jnp.float32)
-        acc = jnp.zeros((b, h, sq, d), jnp.float32)
         qf = ql.astype(jnp.float32)
         q_off = idx * s_local
-        perm = [(i, (i + 1) % p) for i in range(p)]
 
-        def step(carry, t):
-            kb, vb, m, l, acc = carry
+        def attend(t, kb, vb, state):
+            m, l, acc = state
             src = (idx - t) % p  # whose block we currently hold
             k_off = src * s_local
             mask = _causal_mask(sq, s_local, q_off, k_off) if causal else None
-            m, l, acc = _stream_block(qf, kb.astype(jnp.float32), vb,
-                                      m, l, acc, mask)
-            kb = lax.ppermute(kb, seq_axis, perm)
-            vb = lax.ppermute(vb, seq_axis, perm)
-            return (kb, vb, m, l, acc), 0.0
+            return _stream_block(qf, kb.astype(jnp.float32), vb,
+                                 m, l, acc, mask)
 
-        (kb, vb, m, l, acc), _ = lax.scan(step, (kl, vl, m, l, acc),
-                                          jnp.arange(p))
+        m, l, acc = ring_kv(kl, vl,
+                            (jnp.full((b, h, sq), -jnp.inf, jnp.float32),
+                             jnp.zeros((b, h, sq), jnp.float32),
+                             jnp.zeros((b, h, sq, d), jnp.float32)),
+                            attend)
         l = jnp.maximum(l, 1e-30)
         return acc / l[..., None]
 
-    return unchecked_shard_map(local, mesh, (spec, spec, spec), spec)(q, k, v)
+    body = local_flash if use_flash else local
+    return unchecked_shard_map(body, mesh, (spec, spec, spec), spec)(q, k, v)
