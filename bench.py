@@ -16,8 +16,10 @@ import sys
 import time
 
 
-def run(batch_size=64, iters=12, warmup=4, dtype="bfloat16",
+def run(batch_size=1024, iters=12, warmup=4, dtype="bfloat16",
         strategy_file=None):
+    """batch 1024 ≈ single-chip saturation on v5e (64→4.6k, 512→19.9k,
+    1024→23.4k, 2048→25.7k images/s; knee at 1024)."""
     import jax
 
     from flexflow_tpu.config import FFConfig

@@ -71,7 +71,8 @@ def build_model(name: str, machine: MachineModel, batch_size: int):
     builders = _builders()
     if name not in builders:
         raise SystemExit(f"unknown model {name!r}")
-    cfg = FFConfig(batch_size=batch_size)
+    size = 299 if name.startswith("inception") else 224  # v3 is a 299 net
+    cfg = FFConfig(batch_size=batch_size, input_height=size, input_width=size)
     return builders[name](cfg, machine)
 
 
