@@ -115,11 +115,17 @@ class MeasuredCostModel:
         self._save()
         return t
 
+    # bumped when the timing protocol changes (v2 = chained-scan + host
+    # readback; v1 per-call timers read dispatch latency on tunneled TPUs),
+    # so stale on-disk caches are never silently mixed with new timings
+    _PROTOCOL = 2
+
     def _key(self, op: Op, pc: ParallelConfig) -> str:
         shapes = [t.shape for t in op.inputs] + [op.output.shape]
         sig = op.cost_signature()
         extra = f"|{sig}" if sig else ""
-        return f"{type(op).__name__}|{shapes}|{pc.dims}{extra}"
+        return (f"v{self._PROTOCOL}|{type(op).__name__}|{shapes}|{pc.dims}"
+                f"{extra}")
 
     def _measure(self, op: Op, pc: ParallelConfig) -> Optional[float]:
         import jax
