@@ -214,15 +214,61 @@ class FFModel:
                         }
             s = op.init_state()  # state is per-op even under shared params
             if s:
-                state[op.name] = jax.tree.map(
-                    lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), s) \
-                    if abstract else s
+                if abstract:
+                    state[op.name] = jax.tree.map(
+                        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), s)
+                else:
+                    # commit to a concrete (replicated) sharding so the first
+                    # train step's input avals match later steps' outputs —
+                    # uncommitted state would cost one extra full recompile
+                    repl = self.machine.replicated()
+                    state[op.name] = jax.tree.map(
+                        lambda v: jax.device_put(v, repl), s)
         return params, state
 
     def init_opt_state(self, params):
         import jax
 
         return jax.tree.map(lambda p: p * 0.0, params)
+
+    def _param_shardings(self, params):
+        """{param_key: {name: sharding}} mirroring ``params`` — the same
+        shardings init() placed them with."""
+        shardings = {}
+        for op in self.layers:
+            if op.param_key in params and op.param_key not in shardings:
+                sh = op.param_shardings(self.machine)
+                shardings[op.param_key] = {
+                    k: sh[k] for k in params[op.param_key]
+                }
+        return shardings
+
+    def _constrain_params(self, new_params, shardings):
+        """Pin updated params to their init-time shardings inside the
+        jitted step.  Without this the step's outputs carry whatever
+        (default) shardings XLA picked, which differ from the explicitly
+        placed inputs — so the SECOND call retraces and recompiles the
+        whole step (observed: 2 extra ~10 s Inception/NMT compiles and an
+        18x wall-clock regression in the training loop)."""
+        import jax
+        from jax import lax
+
+        return jax.tree.map(
+            lambda p, s: lax.with_sharding_constraint(p, s),
+            new_params, shardings)
+
+    def _constrain_state(self, new_state):
+        """Pin updated per-op state (e.g. BatchNorm running stats) to the
+        replicated sharding init() committed it with — same retrace hazard
+        as _constrain_params, via the state output."""
+        import jax
+        from jax import lax
+
+        if not new_state:
+            return new_state
+        repl = self.machine.replicated()
+        return jax.tree.map(
+            lambda v: lax.with_sharding_constraint(v, repl), new_state)
 
     # ------------------------------------------------------------------
     # execution
@@ -291,7 +337,10 @@ class FFModel:
                                       is_leaf=lambda t: isinstance(t, tuple))
             new_v = jax.tree.map(lambda t: t[1], new_params_and_v,
                                  is_leaf=lambda t: isinstance(t, tuple))
-            return new_params, new_state, new_v, loss
+            psh = self._param_shardings(new_params)
+            return (self._constrain_params(new_params, psh),
+                    self._constrain_state(new_state),
+                    self._constrain_params(new_v, psh), loss)
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
@@ -308,7 +357,10 @@ class FFModel:
             (loss, new_state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
             new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-            return new_params, new_state, opt_state, loss
+            new_params = self._constrain_params(
+                new_params, self._param_shardings(new_params))
+            return new_params, self._constrain_state(new_state), \
+                opt_state, loss
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 

@@ -91,7 +91,12 @@ class MeasuredCostModel:
         self._cache: Dict[str, float] = {}
         if cache_path and os.path.exists(cache_path):
             with open(cache_path) as f:
-                self._cache = json.load(f)
+                loaded = json.load(f)
+            # drop entries from other timing protocols so stale keys don't
+            # accumulate in the file across version bumps
+            pref = f"v{self._PROTOCOL}|"
+            self._cache = {k: v for k, v in loaded.items()
+                           if k.startswith(pref)}
 
     def _save(self, force: bool = False):
         if not self.cache_path or (not force and self._dirty < self.save_every):
