@@ -1,0 +1,113 @@
+"""Transformer LM training driver (BERT-base encoder or GPT-style causal
+decoder, optionally MoE) — completes the driver set for the BASELINE.json
+config "Transformer/BERT-base via linear+softmax ops, full SOAP strategy
+search".  New model capability beyond the reference (which predates
+transformers); flags follow the house style of the reference parsers
+(cnn.cc:539-582).
+
+    python -m flexflow_tpu.apps.lm --causal -b 16 -s 512 -l 12 \
+        --d-model 768 --heads 12 --d-ff 3072 --vocab 32768
+    python -m flexflow_tpu.apps.lm --experts 8 --strategy moe.json
+
+Data is synthetic random tokens; labels are the tokens themselves (causal
+models learn next-token prediction via the internal shift; see
+TransformerLM).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.models.transformer import TransformerConfig, TransformerLM
+from flexflow_tpu.strategy import Strategy
+
+
+def parse_args(argv) -> TransformerConfig:
+    from flexflow_tpu.utils.flags import flag_stream
+
+    cfg = TransformerConfig()
+    strategy_file = ""
+    for a, val in flag_stream(argv):
+        if a == "-b":
+            cfg.batch_size = int(val())
+        elif a in ("-s", "--seq"):
+            cfg.seq_length = int(val())
+        elif a in ("-l", "--layers"):
+            cfg.num_layers = int(val())
+        elif a == "--d-model":
+            cfg.d_model = int(val())
+        elif a == "--heads":
+            cfg.num_heads = int(val())
+        elif a == "--d-ff":
+            cfg.d_ff = int(val())
+        elif a == "--vocab":
+            cfg.vocab_size = int(val())
+        elif a == "--causal":
+            cfg.causal = True
+        elif a == "--experts":
+            cfg.num_experts = int(val())
+        elif a == "--moe-every":
+            cfg.moe_every = int(val())
+        elif a == "--moe-top-k":
+            cfg.moe_top_k = int(val())
+        elif a in ("-i", "--iters", "--iterations"):
+            cfg.num_iterations = int(val())
+        elif a == "--lr":
+            cfg.learning_rate = float(val())
+        elif a == "--dtype":
+            cfg.compute_dtype = val()
+        elif a == "--seed":
+            cfg.seed = int(val())
+        elif a == "--strategy":
+            strategy_file = val()
+        elif a == "--params-ones":
+            cfg.params_init = "ones"
+        elif a == "--print-intermediates":
+            cfg.print_intermediates = True
+        elif a == "--dry-compile":
+            cfg.dry_compile = True
+        # unknown flags ignored, like the reference parser
+    cfg._strategy_file = strategy_file
+    return cfg
+
+
+def synthetic_lm_batches(machine: MachineModel, batch_size: int,
+                         seq_length: int, vocab_size: int, seed: int = 0):
+    """Random token batches, batch-sharded; labels = tokens (TransformerLM
+    shifts internally for causal models)."""
+    from flexflow_tpu.data import synthetic_token_stream
+
+    for (toks,) in synthetic_token_stream(machine, batch_size, seq_length,
+                                          vocab_size, seed, streams=1):
+        yield toks, toks
+
+
+def main(argv=None, log=print) -> dict:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cfg = parse_args(argv)
+    machine = MachineModel()
+    strategies = None
+    if getattr(cfg, "_strategy_file", ""):
+        strategies = Strategy.load(cfg._strategy_file)
+    model = TransformerLM(cfg, machine, strategies)
+    moe = (f", {cfg.num_experts} experts/{cfg.moe_every} blocks"
+           if cfg.num_experts else "")
+    log(f"LM: {'causal' if cfg.causal else 'encoder'}, {cfg.num_layers} "
+        f"layers, d_model {cfg.d_model}, {cfg.num_heads} heads, d_ff "
+        f"{cfg.d_ff}, seq {cfg.seq_length}, vocab {cfg.vocab_size}, batch "
+        f"{cfg.batch_size}{moe}, {machine.num_devices} devices")
+    data = synthetic_lm_batches(machine, cfg.batch_size, cfg.seq_length,
+                                cfg.vocab_size, seed=cfg.seed)
+    out = model.fit(data, log=log)
+    out["tokens_per_sec"] = (out.get("images_per_sec") or 0.0) \
+        * cfg.seq_length
+    if out["tokens_per_sec"]:
+        log(f"tokens/s = {out['tokens_per_sec']:.0f}")
+    out.pop("params", None)
+    out.pop("state", None)
+    return out
+
+
+if __name__ == "__main__":
+    main()

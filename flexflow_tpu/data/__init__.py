@@ -13,12 +13,14 @@ Three sources, mirroring the reference's three loaders (SURVEY.md §2.1):
     (reference legacy loader, ops.cu:281-420).
 """
 
-from flexflow_tpu.data.synthetic import synthetic_batches
+from flexflow_tpu.data.synthetic import (synthetic_batches,
+                                          synthetic_token_stream)
 from flexflow_tpu.data.imagenet import ImageDataset, image_batches
 from flexflow_tpu.data.hdf5 import hdf5_batches
 
 __all__ = [
     "synthetic_batches",
+    "synthetic_token_stream",
     "ImageDataset",
     "image_batches",
     "hdf5_batches",

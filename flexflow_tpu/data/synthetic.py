@@ -64,3 +64,21 @@ def synthetic_batches(machine: MachineModel, batch_size: int, height: int,
     else:
         while True:
             yield make()
+
+
+def synthetic_token_stream(machine: MachineModel, batch_size: int,
+                           seq_length: int, vocab_size: int, seed: int = 0,
+                           streams: int = 2) -> Iterator[Tuple]:
+    """Yield tuples of ``streams`` random int32 token arrays forever,
+    batch-sharded over the machine (streams=2 -> (src, dst) pairs for NMT;
+    streams=1 -> (tokens,) for LMs that reuse tokens as labels)."""
+    import jax
+
+    sh = _batch_sharding(machine)
+    rng = np.random.RandomState(seed)
+    while True:
+        yield tuple(
+            jax.device_put(
+                rng.randint(0, vocab_size,
+                            (batch_size, seq_length)).astype("int32"), sh)
+            for _ in range(streams))

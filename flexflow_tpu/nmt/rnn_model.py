@@ -208,17 +208,10 @@ class RnnModel(FFModel):
 
 def synthetic_token_batches(machine: MachineModel, batch_size: int,
                             seq_length: int, vocab_size: int, seed: int = 0):
-    """Random token pairs, batch-sharded (reference inits word tensors with
-    a constant; random avoids degenerate instant memorization)."""
-    import jax
-    import numpy as np
-    from jax.sharding import PartitionSpec as P
+    """Random (src, dst) token pairs, batch-sharded (reference inits word
+    tensors with a constant; random avoids degenerate instant
+    memorization)."""
+    from flexflow_tpu.data import synthetic_token_stream
 
-    n = machine.num_devices
-    sh = machine.sharding(ParallelConfig((n,), tuple(range(n))), ("n",),
-                          P("n"))
-    rng = np.random.RandomState(seed)
-    while True:
-        src = rng.randint(0, vocab_size, (batch_size, seq_length)).astype("int32")
-        dst = rng.randint(0, vocab_size, (batch_size, seq_length)).astype("int32")
-        yield jax.device_put(src, sh), jax.device_put(dst, sh)
+    return synthetic_token_stream(machine, batch_size, seq_length,
+                                  vocab_size, seed, streams=2)

@@ -110,3 +110,40 @@ def test_search_app_virtual_machine_larger_than_local():
     assert out["devices"] == 32
     for pc in out["strategy"].values():
         assert all(0 <= d < 32 for d in pc.devices)
+
+
+def test_lm_flag_parity():
+    from flexflow_tpu.apps.lm import parse_args
+
+    cfg = parse_args(["--causal", "-b", "4", "-s", "32", "-l", "2",
+                      "--d-model", "16", "--heads", "4", "--d-ff", "32",
+                      "--vocab", "128", "--experts", "4", "-i", "3"])
+    assert cfg.causal and cfg.batch_size == 4 and cfg.seq_length == 32
+    assert cfg.num_layers == 2 and cfg.d_model == 16 and cfg.num_heads == 4
+    assert cfg.d_ff == 32 and cfg.vocab_size == 128
+    assert cfg.num_experts == 4 and cfg.num_iterations == 3
+
+
+def test_lm_app_end_to_end(machine8):
+    from flexflow_tpu.apps import lm
+
+    out = lm.main(["--causal", "-b", "8", "-s", "16", "-l", "2",
+                   "--d-model", "16", "--heads", "4", "--d-ff", "32",
+                   "--vocab", "64", "-i", "2"], log=lambda *a: None)
+    assert np.isfinite(out["loss"]).all()
+    assert out["tokens_per_sec"] >= 0
+
+
+def test_lm_app_moe_with_strategy(machine8, tmp_path):
+    from flexflow_tpu.apps import lm
+    from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+    s = Strategy()
+    s["blk0_moe"] = ParallelConfig((4, 1, 2), tuple(range(8)))  # EP x DP
+    sf = str(tmp_path / "moe.json")
+    s.save(sf)
+    out = lm.main(["--causal", "-b", "8", "-s", "16", "-l", "2",
+                   "--d-model", "16", "--heads", "4", "--d-ff", "32",
+                   "--vocab", "64", "--experts", "4", "-i", "2",
+                   "--strategy", sf], log=lambda *a: None)
+    assert np.isfinite(out["loss"]).all()
