@@ -68,17 +68,22 @@ def synthetic_batches(machine: MachineModel, batch_size: int, height: int,
 
 def synthetic_token_stream(machine: MachineModel, batch_size: int,
                            seq_length: int, vocab_size: int, seed: int = 0,
-                           streams: int = 2) -> Iterator[Tuple]:
+                           streams: int = 2,
+                           cycle: int = 2) -> Iterator[Tuple]:
     """Yield tuples of ``streams`` random int32 token arrays forever,
     batch-sharded over the machine (streams=2 -> (src, dst) pairs for NMT;
-    streams=1 -> (tokens,) for LMs that reuse tokens as labels)."""
+    streams=1 -> (tokens,) for LMs that reuse tokens as labels).  Like
+    :func:`synthetic_batches`, ``cycle`` distinct batches are pre-generated
+    and cycled so the training loop does no host-side data work."""
+    import itertools
+
     import jax
 
     sh = _batch_sharding(machine)
     rng = np.random.RandomState(seed)
-    while True:
-        yield tuple(
-            jax.device_put(
-                rng.randint(0, vocab_size,
-                            (batch_size, seq_length)).astype("int32"), sh)
-            for _ in range(streams))
+    ring = [tuple(
+        jax.device_put(
+            rng.randint(0, vocab_size,
+                        (batch_size, seq_length)).astype("int32"), sh)
+        for _ in range(streams)) for _ in range(cycle)]
+    return itertools.cycle(ring)

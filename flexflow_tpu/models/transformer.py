@@ -108,11 +108,24 @@ class TransformerLM(FFModel):
     # ------------------------------------------------------------------
 
     def loss_fn(self, params, state, tokens, labels, train: bool = True):
+        import jax.numpy as jnp
+
+        if self.t.causal:
+            # next-token objective: position i predicts labels[i+1]; the
+            # final position has no target (-1 = ignore, masked in
+            # SoftmaxDP.loss).  Without this shift a causal model would
+            # train on the degenerate copy task labels[i] = tokens[i].
+            labels = jnp.concatenate(
+                [labels[:, 1:],
+                 jnp.full((labels.shape[0], 1), -1, labels.dtype)], axis=1)
         inputs = {self.tokens.tid: tokens, self.labels.tid: labels}
         values, new_state = self.apply(params, state, inputs, train)
         op = self.loss_op
         total = op.loss(values[op.output.tid], values[op.labels_tensor.tid])
-        loss = total / (self.t.batch_size * self.t.seq_length)
+        n_targets = self.t.batch_size * (self.t.seq_length - 1
+                                         if self.t.causal
+                                         else self.t.seq_length)
+        loss = total / n_targets
         if train:  # aux balance term is a training regularizer only;
             # eval loss stays plain CE (comparable across configs)
             for tid in getattr(self, "_moe_aux_tids", ()):

@@ -41,7 +41,16 @@ class SoftmaxDP(Op):
         return jax.nn.log_softmax(logits.astype("float32"), axis=-1), state
 
     def loss(self, log_probs, labels):
+        """Sum of NLL over non-ignored tokens (label -1 = no target, e.g.
+        the final position of a causal next-token shift)."""
         import jax.numpy as jnp
 
-        nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)
-        return jnp.sum(nll)
+        valid = labels >= 0
+        if log_probs.ndim == labels.ndim:
+            # fused path (FFModel._lm_head_fusion): the op's value is
+            # already per-token NLL from the Pallas projection+CE kernel
+            return jnp.sum(jnp.where(valid, log_probs, 0.0))
+        nll = -jnp.take_along_axis(log_probs,
+                                   jnp.where(valid, labels, 0)[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.sum(jnp.where(valid, nll, 0.0))
