@@ -1,0 +1,92 @@
+"""Multi-host entry point: the GASNet/Legion-transport analog.
+
+The reference scales across nodes by building Legion with GASNet
+(USE_GASNET=1, nmt/Makefile:24; `-d` flag README.md:38-41) and launching
+one rank per node; Legion/Realm then move region data over the wire.  The
+TPU-native equivalent is `jax.distributed` + GSPMD: every host runs THE
+SAME program, `initialize()` connects them, and `jax.devices()` then spans
+every chip in the slice/pod — after which the entire framework works
+unchanged (a MachineModel over the global device list; XLA emits ICI
+collectives within a slice and DCN collectives across slices from exactly
+the same sharding annotations).
+
+    # on every host (e.g. via gcloud alpha compute tpus tpu-vm ssh --worker=all)
+    from flexflow_tpu import distributed
+    machine = distributed.initialize()          # TPU pods: auto-detected
+    ff = build_inception_v3(cfg, machine)       # unchanged from 1 chip
+
+There is no per-op communication code anywhere to port — SURVEY.md §2.7:
+communication is derived, not written.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from flexflow_tpu.machine import MachineModel, Topology
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None,
+               topology: Optional[Topology] = None) -> MachineModel:
+    """Connect this process to the cluster and return the global machine.
+
+    On Cloud TPU all arguments are auto-detected from the metadata server;
+    elsewhere pass coordinator_address ("host:port" of process 0),
+    num_processes, and process_id.  Single-process (the common dev case)
+    skips `jax.distributed` entirely and is a no-op wrapper around
+    ``MachineModel()``.
+
+    The returned MachineModel spans every device of every process, with a
+    two-tier Topology (ICI inside a slice = this host's local device
+    count per group by default; DCN across) feeding the strategy-search
+    cost model."""
+    import os
+
+    import jax
+
+    explicit = (coordinator_address is not None
+                or (num_processes or 0) > 1 or process_id is not None)
+    # env markers Cloud TPU sets on multi-host slices — the zero-arg
+    # auto-detect path only fires there, so single-process dev boxes
+    # (CPU tests, tunneled single chips) never touch jax.distributed
+    auto = any(m in os.environ for m in (
+        "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
+        "MEGASCALE_COORDINATOR_ADDRESS", "TPU_PROCESS_ADDRESSES"))
+    if explicit:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids)
+    elif auto:
+        try:
+            jax.distributed.initialize()  # args metadata-auto-detected
+        except (RuntimeError, ValueError):
+            # backend already initialized (dev sessions that imported jax
+            # first) or metadata incomplete (RuntimeError / ValueError
+            # 'coordinator_address should be defined'): stay
+            # single-process — the env markers alone are not proof of a
+            # usable cluster
+            pass
+    multiprocess = jax.process_count() > 1
+    devices = jax.devices()
+    if topology is None and multiprocess:
+        # ICI inside each host's slice, DCN across — feed the two-tier
+        # cost model accordingly (single-process keeps MachineModel's
+        # own all-ICI default)
+        topology = Topology(
+            devices_per_ici_group=max(len(jax.local_devices()), 1))
+    return MachineModel(devices=devices, topology=topology)
+
+
+def shutdown() -> None:
+    """Tear down the jax.distributed client (idempotent)."""
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass

@@ -51,3 +51,23 @@ def test_topology_tiers():
     assert topo.bandwidth(0, 0) == float("inf")
     assert topo.bandwidth(0, 3) == 9e10
     assert topo.bandwidth(0, 4) == 2.5e10
+
+
+def test_distributed_single_process_noop():
+    import jax
+
+    from flexflow_tpu import distributed
+
+    m = distributed.initialize()
+    assert m.num_devices == len(jax.devices())
+    assert m.topology.devices_per_ici_group == m.num_devices
+    distributed.shutdown()  # idempotent no-op
+
+
+def test_distributed_custom_topology():
+    from flexflow_tpu import distributed
+    from flexflow_tpu.machine import Topology
+
+    topo = Topology(devices_per_ici_group=4)
+    m = distributed.initialize(topology=topo)
+    assert m.topology.devices_per_ici_group == 4
