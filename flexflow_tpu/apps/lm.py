@@ -67,6 +67,10 @@ def parse_args(argv) -> TransformerConfig:
             cfg.print_intermediates = True
         elif a == "--dry-compile":
             cfg.dry_compile = True
+        elif a == "--pipeline-stages":
+            cfg._pipeline_stages = int(val())
+        elif a == "--microbatches":
+            cfg._microbatches = int(val())
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg
@@ -83,10 +87,53 @@ def synthetic_lm_batches(machine: MachineModel, batch_size: int,
         yield toks, toks
 
 
+def _main_pipelined(cfg, machine, log) -> dict:
+    """--pipeline-stages path: GPipe microbatch pipelining (PP x DP) of
+    the block stack via parallel.pipeline.PipelinedLM."""
+    import time
+
+    from flexflow_tpu.parallel.pipeline import PipelinedLM
+
+    model = PipelinedLM(
+        machine, cfg._pipeline_stages,
+        getattr(cfg, "_microbatches", 0) or cfg._pipeline_stages,
+        num_layers=cfg.num_layers, d_model=cfg.d_model,
+        num_heads=cfg.num_heads, d_ff=cfg.d_ff,
+        vocab_size=cfg.vocab_size, seq_length=cfg.seq_length,
+        batch_size=cfg.batch_size, causal=cfg.causal,
+        learning_rate=cfg.learning_rate, compute_dtype=cfg.compute_dtype)
+    log(f"LM pipeline: {cfg.num_layers} layers over {model.S} stages x "
+        f"{machine.num_devices // model.S} dp, {model.M} microbatches, "
+        f"batch {cfg.batch_size}, seq {cfg.seq_length}")
+    params = model.init(cfg.seed)
+    step = model.make_train_step()
+    data = synthetic_lm_batches(machine, cfg.batch_size, cfg.seq_length,
+                                cfg.vocab_size, seed=cfg.seed)
+    losses = []
+    toks, labs = next(data)
+    params, loss = step(params, toks, labs)  # iteration 1 = compile + warm
+    losses.append(float(loss))
+    n_timed = cfg.num_iterations - 1
+    t0 = time.perf_counter()
+    for _ in range(n_timed):
+        toks, labs = next(data)
+        params, loss = step(params, toks, labs)
+        losses.append(loss)
+    losses = [float(l) for l in losses]
+    elapsed = time.perf_counter() - t0
+    tput = (n_timed * cfg.batch_size / elapsed
+            if n_timed and elapsed > 0 else 0.0)
+    log(f"time = {elapsed:.4f}s, tp = {tput:.2f} images/s")
+    return {"loss": losses, "images_per_sec": tput,
+            "tokens_per_sec": tput * cfg.seq_length, "elapsed_s": elapsed}
+
+
 def main(argv=None, log=print) -> dict:
     argv = list(sys.argv[1:] if argv is None else argv)
     cfg = parse_args(argv)
     machine = MachineModel()
+    if getattr(cfg, "_pipeline_stages", 0) > 1:
+        return _main_pipelined(cfg, machine, log)
     strategies = None
     if getattr(cfg, "_strategy_file", ""):
         strategies = Strategy.load(cfg._strategy_file)

@@ -213,3 +213,141 @@ def place_stage_params(params, mesh: Mesh, stage_axis: str = "stage"):
             p, NamedSharding(mesh, P(*((stage_axis,) +
                                        (None,) * (p.ndim - 1))))),
         params)
+
+
+# ----------------------------------------------------------------------
+# PipelinedLM: a complete causal/encoder LM trained through the GPipe
+# ring — the driver-level integration of pipeline parallelism
+# (apps/lm --pipeline-stages), composing PP (stage axis) x DP (n axis).
+
+
+class PipelinedLM:
+    """Embed -> L transformer blocks split over S pipeline stages ->
+    final-norm -> vocab head + CE.  Blocks run through spmd_pipeline on a
+    ('stage', 'n') mesh; embed/head run under plain GSPMD batch sharding.
+
+    Not an FFModel: stage params are stacked on a leading axis (one slice
+    per device along 'stage'), which is a different parameter layout than
+    the op DAG; the op-DAG path covers per-layer SOAP strategies, this
+    class covers explicit microbatch pipelining of a homogeneous stack.
+    """
+
+    def __init__(self, machine, num_stages: int, num_microbatches: int,
+                 num_layers: int = 12, d_model: int = 768,
+                 num_heads: int = 12, d_ff: int = 3072,
+                 vocab_size: int = 32768, seq_length: int = 512,
+                 batch_size: int = 16, causal: bool = True,
+                 learning_rate: float = 1e-3, compute_dtype="float32"):
+        import numpy as np
+
+        if num_layers % num_stages:
+            raise ValueError(f"{num_layers} layers not divisible into "
+                             f"{num_stages} stages")
+        if machine.num_devices % num_stages:
+            raise ValueError(f"{machine.num_devices} devices not divisible "
+                             f"into {num_stages} stages")
+        if batch_size % num_microbatches:
+            raise ValueError("batch not divisible by microbatches")
+        dp = machine.num_devices // num_stages
+        if (batch_size // num_microbatches) % dp:
+            raise ValueError(
+                f"microbatch size {batch_size // num_microbatches} not "
+                f"divisible by the data-parallel axis ({dp} devices)")
+        self.machine = machine
+        self.S, self.M = num_stages, num_microbatches
+        self.L, self.D, self.H = num_layers, d_model, num_heads
+        self.F, self.V = d_ff, vocab_size
+        self.seq, self.batch = seq_length, batch_size
+        self.causal = causal
+        self.lr = learning_rate
+        self.dtype = compute_dtype
+        dev = np.empty(machine.num_devices, object)
+        for i, d in enumerate(machine.devices):
+            dev[i] = d
+        self.mesh = Mesh(dev.reshape(num_stages, dp), ("stage", "n"))
+        self.block = transformer_block_fn(num_heads, causal)
+
+    # -- params ---------------------------------------------------------
+
+    def init(self, seed: int = 0):
+        k0, k1, k2 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        blocks = init_block_stack(k0, self.L, self.D, self.F)
+        # (L, ...) -> (S, L/S, ...): one leading slice per stage
+        blocks = jax.tree.map(
+            lambda p: p.reshape((self.S, self.L // self.S) + p.shape[1:]),
+            blocks)
+        blocks = place_stage_params(blocks, self.mesh)
+        repl = NamedSharding(self.mesh, P())
+        scale = 1.0 / jnp.sqrt(jnp.asarray(self.D, "float32"))
+        other = {
+            "embed": jax.random.normal(k1, (self.V, self.D), "float32")
+            * scale,
+            "pos": jax.random.normal(k2, (self.seq, self.D), "float32")
+            * scale,
+            "ln_f": jnp.stack([jnp.ones((self.D,), "float32"),
+                               jnp.zeros((self.D,), "float32")]),
+            "head_w": jnp.zeros((self.D, self.V), "float32"),
+            "head_b": jnp.zeros((self.V,), "float32"),
+        }
+        other = {k: jax.device_put(v, repl) for k, v in other.items()}
+        return {"blocks": blocks, **other}
+
+    # -- forward/loss ---------------------------------------------------
+
+    def _stage_fn(self):
+        block, n_sub, dtype = self.block, self.L // self.S, self.dtype
+
+        def stage(p, x):
+            p = jax.tree.map(lambda q: q.astype(dtype), p)
+            for i in range(n_sub):  # static sub-layer loop within a stage
+                x = block(jax.tree.map(lambda q: q[i], p), x)
+            return x
+
+        return stage
+
+    def _embed(self, params, tokens):
+        return params["embed"].astype(self.dtype)[tokens] \
+            + params["pos"].astype(self.dtype)[None]
+
+    def _head_loss(self, params, ys, labels):
+        """Final-norm + vocab head + shifted masked CE over the
+        (M, mb, seq, D) pipeline outputs — shared by the pipelined and
+        sequential-reference paths so their semantics cannot drift."""
+        y = ys.reshape(self.batch, self.seq, self.D)
+        y = _layer_norm(params["ln_f"][0], params["ln_f"][1],
+                        y.astype("float32"))
+        logits = y @ params["head_w"] + params["head_b"]
+        if self.causal:
+            labels = jnp.concatenate(
+                [labels[:, 1:],
+                 jnp.full((labels.shape[0], 1), -1, labels.dtype)], axis=1)
+        valid = labels >= 0
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            lp, jnp.where(valid, labels, 0)[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(valid, nll, 0.0)) \
+            / jnp.maximum(valid.sum(), 1)
+
+    def loss_fn(self, params, tokens, labels):
+        xs = microbatch(self._embed(params, tokens), self.M)
+        ys = spmd_pipeline(self._stage_fn(), params["blocks"], xs,
+                           self.mesh, batch_spec=P("n"))
+        return self._head_loss(params, ys, labels)
+
+    def loss_reference(self, params, tokens, labels):
+        """Same model WITHOUT the pipeline ring (sequential stages) —
+        pins the pipelined semantics in tests."""
+        xs = microbatch(self._embed(params, tokens), self.M)
+        ys = sequential_reference(self._stage_fn(), params["blocks"], xs)
+        return self._head_loss(params, ys, labels)
+
+    # -- training -------------------------------------------------------
+
+    def make_train_step(self):
+        def step(params, tokens, labels):
+            loss, g = jax.value_and_grad(self.loss_fn)(params, tokens,
+                                                       labels)
+            new = jax.tree.map(lambda p, gr: p - self.lr * gr, params, g)
+            return new, loss
+
+        return jax.jit(step, donate_argnums=(0,))
