@@ -133,6 +133,18 @@ def main(argv=None, log=print) -> dict:
     cfg = parse_args(argv)
     machine = MachineModel()
     if getattr(cfg, "_pipeline_stages", 0) > 1:
+        unsupported = [flag for flag, on in (
+            ("--strategy", bool(getattr(cfg, "_strategy_file", ""))),
+            ("--experts", cfg.num_experts > 0),
+            ("--dry-compile", cfg.dry_compile),
+            ("--params-ones", cfg.params_init == "ones"),
+            ("--print-intermediates", cfg.print_intermediates),
+        ) if on]
+        if unsupported:
+            raise SystemExit(
+                f"--pipeline-stages does not support: "
+                f"{', '.join(unsupported)} (the pipelined path trains a "
+                f"homogeneous dense block stack outside the op DAG)")
         return _main_pipelined(cfg, machine, log)
     strategies = None
     if getattr(cfg, "_strategy_file", ""):

@@ -117,7 +117,8 @@ class FFModel:
         from flexflow_tpu.ops.embed import Embed
 
         return self._add(Embed(name, self._pc(name, 1), input, vocab_size,
-                               embed_size, param_key))
+                               embed_size, param_key,
+                               compute_dtype=self.config.compute_dtype))
 
     def pos_embed(self, name, input) -> Tensor:
         from flexflow_tpu.ops.seq_common import PosEmbed

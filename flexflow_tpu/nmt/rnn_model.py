@@ -141,7 +141,8 @@ class RnnModel(FFModel):
             key = "srcEmbed" if i < npc else "dstEmbed"
             embeds.append(self._add(Embed(
                 f"embed{i}", pc(f"embed{i}", 1), tok,
-                cfg.vocab_size, cfg.embed_size, param_key=key)))
+                cfg.vocab_size, cfg.embed_size, param_key=key,
+                compute_dtype=cfg.compute_dtype)))
 
         # LSTM grid: lstm[layer][chunk] (nmt/rnn.cu:298-318)
         lstm_out = [[None] * (2 * npc) for _ in range(cfg.num_layers)]

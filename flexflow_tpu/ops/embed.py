@@ -20,15 +20,21 @@ class Embed(Op):
 
     def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
                  vocab_size: int, embed_size: int,
-                 param_key: str = None):
+                 param_key: str = None, compute_dtype: str = "float32"):
         super().__init__(name, pc, [input])
         assert input.ndim == 2, "embed input must be (batch, length) int ids"
         self.vocab_size = vocab_size
         self.embed_size = embed_size
+        # token models have no float graph input to cast, so the model's
+        # compute_dtype is applied HERE, at the source of the float path —
+        # every downstream seq op follows x.dtype (the CNN path's analog
+        # is make_train_step's image.astype)
+        self.compute_dtype = compute_dtype
         if param_key:
             self.param_key = param_key
         n, length = input.shape
-        self.output = Tensor((n, length, embed_size), "float32", self, name)
+        self.output = Tensor((n, length, embed_size), compute_dtype, self,
+                             name)
 
     def init_params(self, rng) -> Dict:
         import jax
@@ -52,7 +58,11 @@ class Embed(Op):
         import jax.numpy as jnp
 
         (ids,) = xs
-        return jnp.take(params["table"], ids, axis=0), state
+        # gather first, cast after: avoids materializing a whole-vocab
+        # low-precision table copy, and the autodiff transpose (scatter-
+        # add of token gradients) then accumulates in the table's f32
+        return (jnp.take(params["table"], ids, axis=0)
+                .astype(self.compute_dtype)), state
 
     def param_bytes(self) -> int:
         return 4 * self.vocab_size * self.embed_size

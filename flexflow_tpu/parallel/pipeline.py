@@ -306,7 +306,9 @@ class PipelinedLM:
         return stage
 
     def _embed(self, params, tokens):
-        return params["embed"].astype(self.dtype)[tokens] \
+        # gather before casting (f32 scatter-add in the VJP, no full-
+        # vocab low-precision table copy)
+        return params["embed"][tokens].astype(self.dtype) \
             + params["pos"].astype(self.dtype)[None]
 
     def _head_loss(self, params, ys, labels):
