@@ -129,3 +129,26 @@ def test_eval_step(machine8):
     loss, acc = ev(params, state, img, lbl)
     assert np.isfinite(float(loss))
     assert 0.0 <= float(acc) <= 1.0
+
+
+def test_compute_dtype_reaches_token_models(machine8):
+    """--dtype must propagate from the embedding through the whole seq
+    stack (regression: it used to stop at the f32 embed output)."""
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+
+    tcfg = TransformerConfig(batch_size=8, seq_length=16, num_layers=1,
+                             d_model=16, num_heads=4, d_ff=32,
+                             vocab_size=64, causal=True,
+                             compute_dtype="bfloat16")
+    tlm = TransformerLM(tcfg, machine8)
+    params, state = tlm.init(seed=0)
+    import jax.numpy as jnp
+    toks = jnp.zeros((8, 16), "int32")
+    values, _ = tlm.apply(params, state,
+                          {tlm.tokens.tid: toks, tlm.labels.tid: toks},
+                          train=True)
+    embed_out = values[tlm.layers[0].output.tid]
+    assert embed_out.dtype == jnp.bfloat16
+    # master params stay f32 (bf16 is compute-only)
+    assert params["embed"]["table"].dtype == jnp.float32
