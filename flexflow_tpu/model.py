@@ -316,7 +316,7 @@ class FFModel:
         pc_c, pn = lin.pc.dims
         b, s = lin.inputs[0].shape[0], lin.inputs[0].shape[1]
         d = lin.in_channels
-        if pc_c != 1 or d > 4096:  # vocab TP / VMEM-oversized d: unfused
+        if d > 4096:  # VMEM-oversized d: unfused
             return False
         if b * s < 2048:
             # small token counts (e.g. NMT's 640-token chunks) leave the
@@ -324,28 +324,65 @@ class FFModel:
             # there (measured: 1583 vs 1638 img/s NMT, 177 vs 151 img/s LM)
             return False
         nd = self.machine.num_devices
-        if nd == 1 or len(lin.pc.devices) == 1:
+        if pc_c == 1 and (nd == 1 or len(lin.pc.devices) == 1):
             return True
-        return self.machine.is_canonical(lin.pc) and b % max(pn, 1) == 0
+        # multi-device (incl. vocab TP): per-shard kernels under shard_map
+        return (self.machine.is_canonical(lin.pc)
+                and b % max(pn, 1) == 0
+                and lin.out_channels % pc_c == 0)
 
     def _run_fused_lm_head(self, lin, lin_params, x, labels):
-        from flexflow_tpu.ops.pallas.fused_ce import fused_linear_ce
+        from flexflow_tpu.ops.pallas.fused_ce import (fused_linear_ce,
+                                                      fused_linear_ce_partial)
 
         b_, s_, d_ = x.shape
         xf = x.reshape(b_ * s_, d_)
         labf = labels.reshape(-1)
         w, bias = lin_params["kernel"], lin_params["bias"]
+        pc_c = lin.pc.dims[0]
         if self.machine.num_devices > 1 and len(lin.pc.devices) > 1:
+            import jax.numpy as jnp
+            from jax import lax
             from jax.sharding import PartitionSpec as P
 
             from flexflow_tpu.parallel.ring_attention import \
                 unchecked_shard_map
 
             mesh = self.machine.mesh_for(lin.pc, lin.AXIS_NAMES)
-            nll = unchecked_shard_map(
-                fused_linear_ce, mesh,
-                (P("n", None), P(None, None), P(None), P("n")),
-                P("n"))(xf, w, bias, labf)
+            if pc_c == 1:
+                nll = unchecked_shard_map(
+                    fused_linear_ce, mesh,
+                    (P("n", None), P(None, None), P(None), P("n")),
+                    P("n"))(xf, w, bias, labf)
+            else:
+                # vocab TP: each c-shard runs the kernel over its vocab
+                # slice with localized labels, then shards merge exactly —
+                # lse by logsumexp, the correct-logit term by sum (a label
+                # lives in exactly one shard; elsewhere nll_c == lse_c).
+                # This is the reference's BWD2/replica reduction
+                # (nmt/linear.cu:570-603) done on partial CE statistics
+                # instead of materialized logits.
+                v_local = lin.out_channels // pc_c
+
+                def local(xl, wl, bl, labl):
+                    lab_local = labl - lax.axis_index("c") * v_local
+                    nll_c, lse_c = fused_linear_ce_partial(
+                        xl, wl, bl, lab_local)
+                    # stability shift only — gradients cancel through m,
+                    # and pmax has no differentiation rule, so detach its
+                    # input before the collective
+                    m = lax.pmax(lax.stop_gradient(lse_c), "c")
+                    # one fused all-reduce for both statistics
+                    sums = lax.psum(
+                        jnp.stack([jnp.exp(lse_c - m), lse_c - nll_c]),
+                        "c")
+                    lse_g = m + jnp.log(jnp.maximum(sums[0], 1e-30))
+                    return lse_g - sums[1]
+
+                nll = unchecked_shard_map(
+                    local, mesh,
+                    (P("n", None), P(None, "c"), P("c"), P("n")),
+                    P("n"))(xf, w, bias, labf)
         else:
             nll = fused_linear_ce(xf, w, bias, labf)
         return nll.reshape(b_, s_)

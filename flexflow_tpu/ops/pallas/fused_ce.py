@@ -58,7 +58,9 @@ def _fwd_kernel(x_ref, w_ref, b_ref, lab_ref, nll_ref, lse_ref,
     m_prev = m_scr[:, 0:1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
-    corr_mask = vpos == lab_ref[:]           # (bn, bv) vs (bn, 1) labels
+    # '& valid' so a remote shard's label landing in [vocab, v_pad) can
+    # never match a padded column (robust even if pads were nonzero)
+    corr_mask = jnp.logical_and(vpos == lab_ref[:], valid)
     corr_scr[:, 0:1] += jnp.sum(jnp.where(corr_mask, logits, 0.0),
                                 axis=-1, keepdims=True)
     scale = jnp.exp(m_prev - m_new)
@@ -108,19 +110,22 @@ def _fwd_call(x, w, b2, lab2, vocab, block_n, block_v, interpret):
 # dw = xT @ dlogits, db = sum_rows(dlogits) — logits tiles recomputed
 
 
-def _tile_dlogits(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref, v_off,
-                  vocab):
+def _tile_dlogits(x_ref, w_ref, b_ref, lab_ref, lse_ref, gp_ref, goh_ref,
+                  v_off, vocab):
+    """dlogits tile = g_p * softmax - g_oh * onehot.  For the plain CE op
+    g_p == g_oh == g; the partial (vocab-sharded) form folds the lse
+    cotangent into g_p (d lse/d logits = softmax)."""
     logits = jax.lax.dot_general(x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
     logits = logits + b_ref[:].astype(jnp.float32)
     vpos = v_off + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
     valid = vpos < vocab
     p = jnp.where(valid, jnp.exp(logits - lse_ref[:]), 0.0)
-    onehot = jnp.where(vpos == lab_ref[:], 1.0, 0.0)
-    return g_ref[:] * (p - onehot)            # (bn, bv) f32
+    onehot = jnp.where(jnp.logical_and(vpos == lab_ref[:], valid), 1.0, 0.0)
+    return gp_ref[:] * p - goh_ref[:] * onehot   # (bn, bv) f32
 
 
-def _bwd_dx_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
+def _bwd_dx_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, gp_ref, goh_ref,
                    dx_ref, dx_scr, *, vocab, block_v):
     vi = pl.program_id(1)
     nv = pl.num_programs(1)
@@ -129,8 +134,8 @@ def _bwd_dx_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
     def _init():
         dx_scr[:] = jnp.zeros(dx_scr.shape, dx_scr.dtype)
 
-    t = _tile_dlogits(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
-                      vi * block_v, vocab)
+    t = _tile_dlogits(x_ref, w_ref, b_ref, lab_ref, lse_ref, gp_ref,
+                      goh_ref, vi * block_v, vocab)
     dx_scr[:] += jax.lax.dot_general(
         t.astype(w_ref.dtype), w_ref[:], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -140,7 +145,7 @@ def _bwd_dx_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
         dx_ref[:] = dx_scr[:].astype(dx_ref.dtype)
 
 
-def _bwd_dw_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
+def _bwd_dw_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, gp_ref, goh_ref,
                    dw_ref, db_ref, dw_scr, db_scr, *, vocab, block_v):
     ni = pl.program_id(1)
     nn = pl.num_programs(1)
@@ -150,8 +155,8 @@ def _bwd_dw_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
         dw_scr[:] = jnp.zeros(dw_scr.shape, dw_scr.dtype)
         db_scr[:] = jnp.zeros(db_scr.shape, db_scr.dtype)
 
-    t = _tile_dlogits(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
-                      pl.program_id(0) * block_v, vocab)
+    t = _tile_dlogits(x_ref, w_ref, b_ref, lab_ref, lse_ref, gp_ref,
+                      goh_ref, pl.program_id(0) * block_v, vocab)
     x = x_ref[:]
     dw_scr[:] += jax.lax.dot_general(
         x, t.astype(x.dtype), (((0,), (0,)), ((), ())),
@@ -164,7 +169,8 @@ def _bwd_dw_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref,
         db_ref[:] = db_scr[:].astype(db_ref.dtype)
 
 
-def _bwd_call(x, w, b2, lab2, lse, g2, vocab, block_n, block_v, interpret):
+def _bwd_call(x, w, b2, lab2, lse, gp2, goh2, vocab, block_n, block_v,
+              interpret):
     n_p, d_p = x.shape
     v_p = w.shape[1]
     common = dict(vocab=vocab, block_v=block_v)
@@ -179,12 +185,13 @@ def _bwd_call(x, w, b2, lab2, lse, g2, vocab, block_n, block_v, interpret):
             pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
         ],
         out_specs=pl.BlockSpec((block_n, d_p), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n_p, d_p), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_n, d_p), jnp.float32)],
         interpret=interpret,
-    )(x, w, b2, lab2, lse, g2)
+    )(x, w, b2, lab2, lse, gp2, goh2)
     # dw/db: vocab blocks outer, token blocks innermost
     dw, db = pl.pallas_call(
         functools.partial(_bwd_dw_kernel, **common),
@@ -193,6 +200,7 @@ def _bwd_call(x, w, b2, lab2, lse, g2, vocab, block_n, block_v, interpret):
             pl.BlockSpec((block_n, d_p), lambda j, i: (i, 0)),
             pl.BlockSpec((d_p, block_v), lambda j, i: (0, j)),
             pl.BlockSpec((1, block_v), lambda j, i: (0, j)),
+            pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
@@ -210,7 +218,7 @@ def _bwd_call(x, w, b2, lab2, lse, g2, vocab, block_n, block_v, interpret):
             pltpu.VMEM((1, block_v), jnp.float32),
         ],
         interpret=interpret,
-    )(x, w, b2, lab2, lse, g2)
+    )(x, w, b2, lab2, lse, gp2, goh2)
     return dx, dw, db
 
 
@@ -223,7 +231,8 @@ def _should_interpret() -> bool:
 
 
 @functools.lru_cache(maxsize=None)
-def _make_fused(x_shape, v, xdt, wdt, bdt, block_n, block_v, interpret):
+def _make_fused(x_shape, v, xdt, wdt, bdt, block_n, block_v, interpret,
+                with_lse=False):
     n, d = x_shape
     if interpret:
         bn = min(block_n, _round_up(n, 8))
@@ -246,26 +255,58 @@ def _make_fused(x_shape, v, xdt, wdt, bdt, block_n, block_v, interpret):
         lab2 = jnp.pad(labels, (0, n_p - n)).reshape(n_p, 1)
         return xp, wp, b2, lab2
 
-    @jax.custom_vjp
-    def fused(x, w, b, labels):
-        out, _ = fused_fwd(x, w, b, labels)
-        return out
-
-    def fused_fwd(x, w, b, labels):
+    def run_fwd(x, w, b, labels):
         xp, wp, b2, lab2 = prep(x, w, b, labels)
         nll, lse = _fwd_call(xp, wp, b2, lab2, v, bn, bv, interpret)
-        return nll[:n, 0], (xp, wp, b2, lab2, lse)
+        return nll, lse, (xp, wp, b2, lab2, lse)
 
-    def fused_bwd(res, g):
+    def run_bwd(res, g_nll, g_lse=None):
         xp, wp, b2, lab2, lse = res
-        g2 = jnp.pad(g.astype(jnp.float32), (0, n_p - n)).reshape(n_p, 1)
-        dx, dw, db = _bwd_call(xp, wp, b2, lab2, lse, g2, v, bn, bv,
+        goh = jnp.pad(g_nll.astype(jnp.float32),
+                      (0, n_p - n)).reshape(n_p, 1)
+        if g_lse is None:
+            gp = goh          # plain CE: dlogits = g (softmax - onehot)
+        else:
+            # nll = lse - corr and d lse/d logits = softmax, so the lse
+            # cotangent joins the softmax term: gp = g_nll + g_lse
+            gp = goh + jnp.pad(g_lse.astype(jnp.float32),
+                               (0, n_p - n)).reshape(n_p, 1)
+        dx, dw, db = _bwd_call(xp, wp, b2, lab2, lse, gp, goh, v, bn, bv,
                                interpret)
         return (dx[:n, :d].astype(xdt), dw[:d, :v].astype(wdt),
                 db[0, :v].astype(bdt), None)
 
-    fused.defvjp(fused_fwd, fused_bwd)
-    return fused
+    if not with_lse:
+
+        @jax.custom_vjp
+        def fused(x, w, b, labels):
+            nll, _, _ = run_fwd(x, w, b, labels)
+            return nll[:n, 0]
+
+        def fused_fwd(x, w, b, labels):
+            nll, _, res = run_fwd(x, w, b, labels)
+            return nll[:n, 0], res
+
+        def fused_bwd(res, g):
+            return run_bwd(res, g)
+
+        fused.defvjp(fused_fwd, fused_bwd)
+        return fused
+
+    @jax.custom_vjp
+    def fused_p(x, w, b, labels):
+        nll, lse, _ = run_fwd(x, w, b, labels)
+        return nll[:n, 0], lse[:n, 0]
+
+    def fused_p_fwd(x, w, b, labels):
+        nll, lse, res = run_fwd(x, w, b, labels)
+        return (nll[:n, 0], lse[:n, 0]), res
+
+    def fused_p_bwd(res, gs):
+        return run_bwd(res, gs[0], gs[1])
+
+    fused_p.defvjp(fused_p_fwd, fused_p_bwd)
+    return fused_p
 
 
 def fused_linear_ce(x, w, b, labels, block_n=256, block_v=512,
@@ -276,4 +317,21 @@ def fused_linear_ce(x, w, b, labels, block_n=256, block_v=512,
     interpret = _should_interpret() if interpret is None else interpret
     f = _make_fused(tuple(x.shape), w.shape[1], x.dtype.name, w.dtype.name,
                     b.dtype.name, block_n, block_v, interpret)
+    return f(x, w, b, labels)
+
+
+def fused_linear_ce_partial(x, w, b, labels, block_n=256, block_v=512,
+                            interpret=None):
+    """Vocab-shard form: returns ``(nll_local, lse_local)`` over this
+    shard's vocab slice (labels must be pre-localized; out-of-range labels
+    — negative or >= V, including any landing inside the 128-padded vocab
+    tail — match nothing, giving nll_local = lse_local).  Shards combine
+    exactly:
+    lse_g = logsumexp_c(lse_c), corr_g = sum_c(lse_c - nll_c),
+    nll_g = lse_g - corr_g.  Differentiable in both outputs (the lse
+    cotangent folds into the backward kernels' softmax term)."""
+    interpret = _should_interpret() if interpret is None else interpret
+    f = _make_fused(tuple(x.shape), w.shape[1], x.dtype.name, w.dtype.name,
+                    b.dtype.name, block_n, block_v, interpret,
+                    with_lse=True)
     return f(x, w, b, labels)

@@ -236,3 +236,39 @@ def test_lm_head_fusion_grads_match(machine8):
     for a, c in zip(base, fused):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_lm_head_fusion_vocab_tp(machine8):
+    """Vocab-TP fused head (c=4 x n=2 grid, per-shard kernels + lse/corr
+    combine) == unfused GSPMD loss and grads."""
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+    s = Strategy()
+    s["lm_head"] = ParallelConfig((4, 2), tuple(range(8)))
+    tcfg = TransformerConfig(batch_size=8, seq_length=256, num_layers=1,
+                             d_model=16, num_heads=4, d_ff=32,
+                             vocab_size=64, causal=True)
+    toks = jnp.asarray(np.random.RandomState(10).randint(0, 64, (8, 256)),
+                       "int32")
+
+    def run(fused):
+        if fused:
+            os.environ["FLEXFLOW_TPU_FLASH"] = "1"
+        try:
+            tlm = TransformerLM(tcfg, machine8, s)
+            params, state = tlm.init(seed=0)
+            loss, _ = tlm.loss_fn(params, state, toks, toks, train=True)
+            g = jax.grad(lambda p: tlm.loss_fn(p, state, toks, toks,
+                                               train=True)[0])(params)
+            return float(loss), jax.tree.leaves(g)
+        finally:
+            os.environ.pop("FLEXFLOW_TPU_FLASH", None)
+
+    base_loss, base_g = run(False)
+    fused_loss, fused_g = run(True)
+    assert abs(base_loss - fused_loss) < 1e-3, (base_loss, fused_loss)
+    for a, c in zip(base_g, fused_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-3, atol=2e-3)
