@@ -20,15 +20,19 @@ import time
 
 
 def run(model="inception", batch_size=None, iters=10, warmup=3,
-        dtype="bfloat16", strategy_file=None):
+        dtype="bfloat16", strategy_file=None, compile_cache=False):
     import jax
 
-    # persistent XLA compile cache: first-ever run pays ~3 min of Inception
-    # compilation, subsequent runs (e.g. the driver's) start in seconds
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    if compile_cache:
+        # persistent XLA compile cache: first-ever run pays ~3 min of
+        # Inception compilation, subsequent runs (e.g. the driver's) start
+        # in seconds.  Opt-in because it mutates process-global jax config;
+        # the CLI below enables it, library callers are unaffected.
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
     from flexflow_tpu.config import FFConfig
     from flexflow_tpu.data import synthetic_batches
@@ -75,9 +79,10 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
 def main():
     model = os.environ.get("BENCH_MODEL", "inception")
     strategy_file = sys.argv[1] if len(sys.argv) > 1 else None
-    per_chip, tput, elapsed = run(model=model, strategy_file=strategy_file)
+    per_chip, tput, elapsed = run(model=model, strategy_file=strategy_file,
+                                  compile_cache=True)
     if strategy_file:
-        dp_per_chip, _, _ = run(model=model)
+        dp_per_chip, _, _ = run(model=model, compile_cache=True)
         vs_baseline = round(per_chip / dp_per_chip, 4)
     else:
         vs_baseline = 1.0  # benched config is itself the pure-DP baseline

@@ -56,11 +56,20 @@ def initialize(coordinator_address: Optional[str] = None,
         "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
         "MEGASCALE_COORDINATOR_ADDRESS", "TPU_PROCESS_ADDRESSES"))
     if explicit:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids)
+        except RuntimeError as e:
+            # second initialize() in the same process: keep the existing
+            # client (jax.distributed is one-shot; use shutdown() before
+            # reconfiguring).  Anything else (bad coordinator, mismatched
+            # process count) must surface, not silently degrade to a
+            # single-host world.
+            if "already initialized" not in str(e).lower():
+                raise
     elif auto:
         try:
             jax.distributed.initialize()  # args metadata-auto-detected

@@ -10,11 +10,14 @@ ICI/DCN — there is no imperative mapper.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
 from flexflow_tpu.strategy import ParallelConfig
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +64,8 @@ class MachineModel:
             devices_per_ici_group=max(len(self.devices), 1)
         )
         self._mesh_cache: Dict[Tuple, "jax.sharding.Mesh"] = {}
+        self._honored: set = set()
+        self._warned: set = set()
 
     @classmethod
     def virtual(cls, num_devices: int,
@@ -75,11 +80,24 @@ class MachineModel:
         m.devices = list(range(num_devices))
         m.topology = topology or Topology(devices_per_ici_group=num_devices)
         m._mesh_cache = {}
+        m._honored = set()
+        m._warned = set()
         return m
 
     @property
     def num_devices(self) -> int:
         return len(self.devices)
+
+    def _dev_array(self, shape: Tuple[int, ...],
+                   order: Optional[Sequence[int]] = None):
+        """Object ndarray of devices in ``order`` (default canonical),
+        reshaped to ``shape`` — the one builder behind every Mesh here."""
+        idx = order if order is not None else range(len(self.devices))
+        flat = np.empty(len(self.devices) if order is None else len(order),
+                        dtype=object)
+        for i, d in enumerate(idx):
+            flat[i] = self.devices[d]
+        return flat.reshape(shape)
 
     def default_pc(self, ndims: int) -> ParallelConfig:
         """Pure-DP default, the reference's fallback when an op has no
@@ -107,11 +125,9 @@ class MachineModel:
         key = (pc.dims, pc.devices, axis_names)
         mesh = self._mesh_cache.get(key)
         if mesh is None:
-            flat = np.empty(len(pc.devices), dtype=object)
-            for i, d in enumerate(pc.devices):
-                flat[i] = self.devices[d]
-            dev_array = flat.reshape(pc.dims[::-1])  # row-major == devices order
-            mesh = Mesh(dev_array, axis_names[::-1])
+            # row-major flatten == devices order
+            mesh = Mesh(self._dev_array(pc.dims[::-1], pc.devices),
+                        axis_names[::-1])
             self._mesh_cache[key] = mesh
         return mesh
 
@@ -119,6 +135,43 @@ class MachineModel:
         """True when pc's devices are the full machine in natural order —
         the case whose mesh shares the canonical XLA device assignment."""
         return pc.devices == tuple(range(self.num_devices))
+
+    def note_honored(self, pc: ParallelConfig) -> None:
+        """Record that ``pc``'s placement IS honored by an explicit
+        execution path (placement-group shard_map), so :meth:`sharding`
+        does not warn when asked for this pc's normalized param/fallback
+        sharding."""
+        self._honored.add((pc.dims, pc.devices))
+
+    def _warn_once(self, key, msg: str) -> None:
+        if key in self._warned:
+            return
+        self._warned.add(key)
+        logger.warning(msg)
+
+    def placement_mesh(self, dims: Tuple[int, ...],
+                       axis_names: Tuple[str, ...]):
+        """Mesh viewing the machine as (placement blocks x op grid): shape
+        ``(num_devices/prod(dims),) + dims[::-1]`` with axes
+        ``("_pg",) + axis_names[::-1]``, canonical device order.  Used by
+        parallel/placement.py to execute ops on explicit device blocks."""
+        import math
+
+        from jax.sharding import Mesh
+
+        p = math.prod(dims)
+        if self.num_devices % p:
+            raise ValueError(
+                f"placement grid {dims} does not divide the "
+                f"{self.num_devices}-device machine")
+        g = self.num_devices // p
+        key = ("_placement", dims, axis_names)
+        mesh = self._mesh_cache.get(key)
+        if mesh is None:
+            mesh = Mesh(self._dev_array((g,) + dims[::-1]),
+                        ("_pg",) + axis_names[::-1])
+            self._mesh_cache[key] = mesh
+        return mesh
 
     def input_sharding(self, pc: ParallelConfig,
                        axis_names: Tuple[str, ...], spec):
@@ -146,7 +199,19 @@ class MachineModel:
         if self.num_devices % n_parts != 0:
             # grid doesn't divide the machine (non-power-of-2 corner):
             # correct-but-unsharded fallback
+            self._warn_once(
+                ("repl", pc.dims, pc.devices),
+                f"strategy grid {pc.dims} does not divide the "
+                f"{self.num_devices}-device machine; op runs fully "
+                f"replicated (1-device speed)")
             return self.replicated()
+        if (pc.dims, pc.devices) not in self._honored:
+            self._warn_once(
+                ("norm", pc.dims, pc.devices),
+                f"devices {pc.devices} for grid {pc.dims} are not an "
+                f"aligned placeable block; the device list is normalized "
+                f"onto the canonical order (placement not honored — see "
+                f"parallel/placement.py for the supported forms)")
         # Normalized realization: XLA admits exactly one device assignment
         # per computation, so a permuted/subset device list is mapped onto
         # the canonical order, with a leading `_repl` mesh axis replicating
@@ -159,12 +224,9 @@ class MachineModel:
         if mesh is None:
             from jax.sharding import Mesh
 
-            flat = np.empty(self.num_devices, dtype=object)
-            for i, d in enumerate(self.devices):
-                flat[i] = d
             m = self.num_devices // n_parts
-            dev_array = flat.reshape((m,) + pc.dims[::-1])
-            mesh = Mesh(dev_array, ("_repl",) + axis_names[::-1])
+            mesh = Mesh(self._dev_array((m,) + pc.dims[::-1]),
+                        ("_repl",) + axis_names[::-1])
             self._mesh_cache[key] = mesh
         return NamedSharding(mesh, spec)
 

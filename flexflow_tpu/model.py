@@ -177,6 +177,11 @@ class FFModel:
         import jax.numpy as jnp
 
         seed = self.config.seed if seed is None else seed
+        if self.machine.num_devices > 1:
+            # mark honored placements BEFORE param placement asks for
+            # shardings, so subset pcs the placement executor handles do
+            # not draw a false "placement not honored" warning
+            self._placement_schedule(frozenset())
         key = jax.random.PRNGKey(seed)
         all_ones = self.config.params_init == "ones"
         params: Dict[str, Dict] = {}
@@ -287,30 +292,36 @@ class FFModel:
     # region between them (nmt/linear.cu -> nmt/softmax_data_parallel.cu).
 
     def _lm_head_fusion(self):
-        if not hasattr(self, "_fusion_plan"):
-            from flexflow_tpu.ops.pallas import flash_enabled
-            from flexflow_tpu.ops.rnn_linear import RnnLinear
-            from flexflow_tpu.ops.softmax_dp import SoftmaxDP
+        from flexflow_tpu.ops.pallas import flash_enabled
 
-            plan: Dict[int, Any] = {}
-            if flash_enabled():
-                consumers: Dict[int, int] = {}
-                for op in self.layers:
-                    for t in op.inputs:
-                        consumers[t.tid] = consumers.get(t.tid, 0) + 1
-                index = {id(op): i for i, op in enumerate(self.layers)}
-                for i, op in enumerate(self.layers):
-                    if not isinstance(op, SoftmaxDP):
-                        continue
-                    prod = op.inputs[0].producer
-                    if (isinstance(prod, RnnLinear)
-                            and consumers.get(prod.output.tid) == 1
-                            and id(prod) in index
-                            and self._fusion_ok(prod)):
-                        plan[index[id(prod)]] = None   # folded away
-                        plan[i] = prod                 # loss op runs fused
-            self._fusion_plan = plan
-        return self._fusion_plan
+        enabled = flash_enabled()
+        # cache keyed on flash_enabled() so toggling FLEXFLOW_TPU_FLASH on a
+        # live model recomputes the plan instead of silently reusing it
+        cached = getattr(self, "_fusion_plan", None)
+        if cached is not None and cached[0] == enabled:
+            return cached[1]
+        from flexflow_tpu.ops.rnn_linear import RnnLinear
+        from flexflow_tpu.ops.softmax_dp import SoftmaxDP
+
+        plan: Dict[int, Any] = {}
+        if enabled:
+            consumers: Dict[int, int] = {}
+            for op in self.layers:
+                for t in op.inputs:
+                    consumers[t.tid] = consumers.get(t.tid, 0) + 1
+            index = {id(op): i for i, op in enumerate(self.layers)}
+            for i, op in enumerate(self.layers):
+                if not isinstance(op, SoftmaxDP):
+                    continue
+                prod = op.inputs[0].producer
+                if (isinstance(prod, RnnLinear)
+                        and consumers.get(prod.output.tid) == 1
+                        and id(prod) in index
+                        and self._fusion_ok(prod)):
+                    plan[index[id(prod)]] = None   # folded away
+                    plan[i] = prod                 # loss op runs fused
+        self._fusion_plan = (enabled, plan)
+        return plan
 
     def _fusion_ok(self, lin) -> bool:
         pc_c, pn = lin.pc.dims
@@ -387,17 +398,55 @@ class FFModel:
             nll = fused_linear_ce(xf, w, bias, labf)
         return nll.reshape(b_, s_)
 
+    def _placement_schedule(self, exclude: frozenset):
+        """Dataflow schedule with explicit-placement groups (cached per
+        fusion-exclusion set).  Marks grouped pcs as honored so
+        MachineModel.sharding does not warn about their param fallback."""
+        cached = getattr(self, "_sched_cache", None)
+        if cached is not None and cached[0] == exclude:
+            return cached[1]
+        from flexflow_tpu.parallel.placement import (PlacementGroup,
+                                                     plan_schedule)
+
+        sched = plan_schedule(self.layers, self.machine.num_devices,
+                              exclude=exclude)
+        for entry in sched:
+            if isinstance(entry, PlacementGroup):
+                for m in entry.members:
+                    self.machine.note_honored(m.pc)
+        self._sched_cache = (exclude, sched)
+        return sched
+
     def apply(self, params, state, inputs: Dict[int, Any], train: bool):
         """Run the DAG. ``inputs`` maps input-Tensor tid -> array.
         Returns (tensor-values dict, new_state)."""
         from jax import lax
 
+        from flexflow_tpu.parallel.placement import (PlacementGroup,
+                                                     run_group)
+
         multi = self.machine.num_devices > 1
         dump = self.config.print_intermediates
         fusion = self._lm_head_fusion() if (train and not dump) else {}
+        if multi and not dump:
+            schedule = self._placement_schedule(frozenset(fusion))
+        else:
+            schedule = range(len(self.layers))
         values: Dict[int, Any] = dict(inputs)
         new_state: Dict[str, Dict] = {}
-        for i, op in enumerate(self.layers):
+        for entry in schedule:
+            if isinstance(entry, PlacementGroup):
+                outs_by_member = run_group(
+                    self.machine, entry,
+                    [params.get(m.param_key, {}) for m in entry.members],
+                    [[values[t.tid] for t in m.inputs]
+                     for m in entry.members], train)
+                for m, outs in zip(entry.members, outs_by_member):
+                    for t, y in zip(m.all_outputs(), outs):
+                        values[t.tid] = y
+                continue
+            i = entry
+            op = self.layers[i]
             if i in fusion:
                 lin = fusion[i]
                 if lin is None:

@@ -114,6 +114,23 @@ class Op:
         init_params)."""
         return {}
 
+    # ---- explicit placement hooks (parallel/placement.py) --------------
+
+    def input_specs(self, pc: "ParallelConfig" = None):
+        """PartitionSpec per input over AXIS_NAMES, for executing this op
+        under an explicit device-subset placement (shard_map group
+        execution).  ``pc`` defaults to the op's own config; the strategy
+        search passes candidates to ask whether a grid is placeable.
+        None -> op does not support placed execution (under that grid)."""
+        return None
+
+    def placement_signature(self):
+        """Hyperparameters determining this op's computation beyond its
+        input/output shapes.  Two ops may share a placement group (execute
+        concurrently on disjoint device subsets) only when their signatures
+        match.  None -> op does not support placed execution."""
+        return None
+
     def output_sharding(self, machine):
         return machine.sharding(self.pc, self.AXIS_NAMES, self.output_spec())
 

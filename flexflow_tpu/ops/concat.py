@@ -30,6 +30,17 @@ class Concat(Op):
 
         return P("n", "h", "w", "c")
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        pc = pc or self.pc
+        if pc.dims[2] != 1:
+            return None  # channel-split would break the local concat
+        return [P("n", "h", "w", None) for _ in self.inputs]
+
+    def placement_signature(self):
+        return ("concat", len(self.inputs))
+
     def forward(self, params, state, xs: List, train: bool):
         import jax.numpy as jnp
 

@@ -46,6 +46,22 @@ class Conv2D(Op):
         self.output = Tensor((n, out_h, out_w, out_channels),
                              input.dtype, self, name)
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        pc = pc or self.pc
+        # placed execution (shard_map on a device block) supports batch-only
+        # inner grids; spatial/channel splits would need explicit halo
+        # exchange inside the manual region
+        if pc.dims[:3] != (1, 1, 1):
+            return None
+        return [P("n", None, None, None)]
+
+    def placement_signature(self):
+        return (self.in_channels, self.out_channels, self.kernel_h,
+                self.kernel_w, self.stride_h, self.stride_w,
+                self.padding_h, self.padding_w, self.relu)
+
     def init_params(self, rng) -> Dict:
         import jax
 

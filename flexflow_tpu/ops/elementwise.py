@@ -31,6 +31,15 @@ class Add(Op):
 
         return P("n", "h", "w", "c")
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        # elementwise: any inner grid is local when both inputs share it
+        return [P("n", "h", "w", "c"), P("n", "h", "w", "c")]
+
+    def placement_signature(self):
+        return (self.relu,)
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
 

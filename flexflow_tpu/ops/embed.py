@@ -54,6 +54,17 @@ class Embed(Op):
 
         return P("n", None, None)
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None)]
+
+    def placement_signature(self):
+        # embeds pinned to distinct devices (the reference's explicit
+        # GPU-0/1 placement, nmt/nmt.cc:273-299) group when table geometry
+        # matches
+        return (self.vocab_size, self.embed_size, self.compute_dtype)
+
     def forward(self, params, state, xs: List, train: bool):
         import jax.numpy as jnp
 

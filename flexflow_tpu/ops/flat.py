@@ -36,6 +36,14 @@ class Flat(Op):
         # *output* channels of the next linear, not flat's features)
         return P("n", None)
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None, None, None)]  # local reshape per batch shard
+
+    def placement_signature(self):
+        return ("flat",)
+
     def forward(self, params, state, xs: List, train: bool):
         (x,) = xs
         return x.reshape(x.shape[0], -1), state

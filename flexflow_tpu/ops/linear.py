@@ -52,6 +52,17 @@ class Linear(Op):
 
         return P("n", "c")
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        # each c-shard reads the full input slice (the reference's aliased
+        # input partition, linear.cu:166-173): batch over n, replicated
+        # over c
+        return [P("n", None)]
+
+    def placement_signature(self):
+        return (self.in_channels, self.out_channels, self.relu)
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
         import jax.numpy as jnp

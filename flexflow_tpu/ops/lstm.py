@@ -68,6 +68,20 @@ class LSTMChunk(Op):
 
         return [P("n", None, None), P("n", None), P("n", None)]
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        specs = [P("n", None, None)]
+        if self.has_initial_state:
+            specs += [P("n", None), P("n", None)]
+        return specs
+
+    def placement_signature(self):
+        # chunk ops on disjoint devices along a DAG antidiagonal execute
+        # concurrently — the reference's wavefront pipelining
+        # (nmt/rnn.cu:298-326)
+        return (self.input_size, self.hidden_size, self.has_initial_state)
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
         import jax.numpy as jnp

@@ -40,6 +40,18 @@ class Pool2D(Op):
 
         return P("n", "h", "w", "c")
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        pc = pc or self.pc
+        if pc.dims[:3] != (1, 1, 1):
+            return None  # batch-only inner grids (as Conv2D)
+        return [P("n", None, None, None)]
+
+    def placement_signature(self):
+        return (self.kernel_h, self.kernel_w, self.stride_h, self.stride_w,
+                self.padding_h, self.padding_w, self.pool_type, self.relu)
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
         import jax.numpy as jnp

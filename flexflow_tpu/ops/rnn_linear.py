@@ -47,6 +47,14 @@ class RnnLinear(Op):
 
         return P("n", None, "c")
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None, None)]
+
+    def placement_signature(self):
+        return (self.in_channels, self.out_channels)
+
     def forward(self, params, state, xs: List, train: bool):
         import jax.numpy as jnp
 

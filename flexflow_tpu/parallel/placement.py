@@ -1,0 +1,280 @@
+"""Explicit operator placement: executing ops on the device subsets their
+strategy names.
+
+The reference pins each NMT op instance to a specific GPU via mapper tags
+(nmt/rnn_mapper.cc:28-41, 131-135); ops pinned to disjoint GPU sets then
+execute concurrently under Legion's async task graph — that is where its
+operator parallelism and wavefront pipelining over chunk ops come from
+(nmt/rnn.cu:298-326).  Under XLA a jitted program is ONE SPMD computation
+over ONE device assignment, so subset placement cannot be a mapper decision
+made outside the program; it has to be compiled INTO it.  The mechanism
+here:
+
+  * the machine is viewed as a mesh ``("_pg", *op_grid_axes)``: a leading
+    *placement-group* axis of size ``num_devices / subset_size`` over the
+    op's own partition grid;
+  * ops placed on disjoint subsets (and mutually independent in the DAG)
+    are merged into one PLACEMENT GROUP, executed by a single
+    ``shard_map`` whose body switches on ``lax.axis_index("_pg")`` — each
+    device-group runs exactly its own op's branch (MPMD expressed inside
+    SPMD), device-groups owning no op contribute zeros that are never
+    consumed;
+  * each member's parameters are stacked along the group axis and sharded
+    over it, so weights physically live only on the subset that computes
+    with them;
+  * the member's own grid (e.g. Linear's (c, n)) partitions work *within*
+    its subset via the inner mesh axes, with shard_map's transpose
+    inserting the cross-shard reductions (the reference's BWD2/updateGAS).
+
+Supported placements: each op's ``devices`` must be one aligned contiguous
+block ``[g*P, (g+1)*P)`` of the machine (P = the op's grid size).  Ops are
+groupable when they share shapes/hyperparameters (``Op.placement_signature``)
+and declare their input partitioning (``Op.input_specs``).  Anything else
+degrades to the replicated normalization in ``MachineModel.sharding`` with
+a warning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.ops.base import Op
+
+
+@dataclasses.dataclass
+class PlacementGroup:
+    """A set of independent ops executing concurrently on disjoint aligned
+    device blocks."""
+
+    members: List[Op]
+    indices: List[int]        # layer indices of members
+    slots: List[int]          # device-block index per member
+    subset_size: int          # devices per member (= pc.num_parts)
+    n_groups: int             # machine blocks of that size
+
+
+def placement_slot(op: Op, num_devices: int) -> Optional[int]:
+    """Block index if ``op``'s ParallelConfig names a placeable aligned
+    device block that is a strict subset of the machine, else None."""
+    pc = op.pc
+    p = pc.num_parts
+    if num_devices <= 1 or p >= num_devices or num_devices % p:
+        return None
+    g, rem = divmod(pc.devices[0], p)
+    if rem or pc.devices != tuple(range(g * p, (g + 1) * p)):
+        return None
+    if op.placement_signature() is None or op.input_specs() is None:
+        return None
+    if op.init_state():
+        return None  # stateful ops (BatchNorm) not supported placed
+    return g
+
+
+def _signature(op: Op) -> tuple:
+    return (type(op).__name__, op.pc.dims,
+            tuple((t.shape, t.dtype) for t in op.inputs),
+            tuple((t.shape, t.dtype) for t in op.all_outputs()),
+            op.placement_signature())
+
+
+def plan_schedule(layers: Sequence[Op], num_devices: int,
+                  exclude: frozenset = frozenset()):
+    """Dataflow schedule for ``layers``: a list whose entries are either a
+    layer index (execute that op normally) or a :class:`PlacementGroup`
+    (execute its members jointly, placed).  ``exclude`` holds layer
+    indices that must stay un-placed (e.g. ops claimed by the fused-LM-head
+    plan).  Placed ops out of original order are legal because scheduling
+    is by dependencies, like the reference's Legion task graph — grouping
+    independent ops can never create a cycle (a path between group members
+    would make one an ancestor of the other, which grouping forbids)."""
+    n = len(layers)
+    prod_idx: Dict[int, int] = {}
+    for i, op in enumerate(layers):
+        for t in op.all_outputs():
+            prod_idx[t.tid] = i
+    deps: List[List[int]] = []
+    anc: List[set] = []
+    for i, op in enumerate(layers):
+        d = sorted({prod_idx[t.tid] for t in op.inputs
+                    if t.tid in prod_idx})
+        deps.append(d)
+        a = set()
+        for p in d:
+            a |= anc[p]
+            a.add(p)
+        anc.append(a)
+
+    # ---- grouping ----
+    groups: List[dict] = []
+    open_by_sig: Dict[tuple, List[dict]] = {}
+    group_of: Dict[int, int] = {}
+    for i, op in enumerate(layers):
+        if i in exclude:
+            continue
+        g = placement_slot(op, num_devices)
+        if g is None:
+            continue
+        sig = _signature(op)
+        for grp in open_by_sig.get(sig, []):
+            if g in grp["slots"]:
+                continue
+            if any(m in anc[i] for m in grp["indices"]):
+                continue  # dependency path member -> op
+            grp["indices"].append(i)
+            grp["slots"].append(g)
+            group_of[i] = grp["id"]
+            break
+        else:
+            grp = {"id": len(groups), "indices": [i], "slots": [g],
+                   "subset": op.pc.num_parts}
+            groups.append(grp)
+            open_by_sig.setdefault(sig, []).append(grp)
+            group_of[i] = grp["id"]
+
+    # ---- merge into schedule nodes + topological order ----
+    # Merging keeps each group acyclic (a path between members would make
+    # one an ancestor of the other), but cycles can still arise BETWEEN two
+    # multi-member group nodes (A->B and C->D with {A,D} and {B,C} merged).
+    # When the topological sort detects one, split the last-added member
+    # out of an involved multi-member group and retry — each split strictly
+    # shrinks a group, so this terminates.
+    while True:
+        node_members: List[List[int]] = []
+        node_of_layer: Dict[int, int] = {}
+        node_group: List[Optional[int]] = []
+        for i in range(n):
+            if i in node_of_layer:
+                continue
+            if i in group_of:
+                members = groups[group_of[i]]["indices"]
+                nid = len(node_members)
+                node_members.append(members)
+                node_group.append(group_of[i])
+                for j in members:
+                    node_of_layer[j] = nid
+            else:
+                nid = len(node_members)
+                node_members.append([i])
+                node_group.append(None)
+                node_of_layer[i] = nid
+
+        nn = len(node_members)
+        ndeps: List[set] = [set() for _ in range(nn)]
+        nsucc: List[set] = [set() for _ in range(nn)]
+        for nid, members in enumerate(node_members):
+            for i in members:
+                for p in deps[i]:
+                    pn = node_of_layer[p]
+                    if pn != nid:
+                        ndeps[nid].add(pn)
+                        nsucc[pn].add(nid)
+        indeg = [len(d) for d in ndeps]
+        heap = [(min(node_members[nid]), nid) for nid in range(nn)
+                if indeg[nid] == 0]
+        heapq.heapify(heap)
+        schedule = []
+        done = [False] * nn
+        while heap:
+            _, nid = heapq.heappop(heap)
+            done[nid] = True
+            gid = node_group[nid]
+            if gid is None:
+                schedule.append(node_members[nid][0])
+            else:
+                grp = groups[gid]
+                schedule.append(PlacementGroup(
+                    members=[layers[i] for i in grp["indices"]],
+                    indices=list(grp["indices"]),
+                    slots=list(grp["slots"]),
+                    subset_size=grp["subset"],
+                    n_groups=num_devices // grp["subset"]))
+            for s in nsucc[nid]:
+                indeg[s] -= 1
+                if indeg[s] == 0:
+                    heapq.heappush(heap, (min(node_members[s]), s))
+        if len(schedule) == nn:
+            return schedule
+        split = None
+        for nid in range(nn):
+            if not done[nid] and node_group[nid] is not None \
+                    and len(node_members[nid]) > 1:
+                split = node_group[nid]
+                break
+        assert split is not None, "cycle without a splittable group"
+        last = groups[split]["indices"].pop()
+        groups[split]["slots"].pop()
+        grp = {"id": len(groups), "indices": [last],
+               "slots": [placement_slot(layers[last], num_devices)],
+               "subset": layers[last].pc.num_parts}
+        groups.append(grp)
+        group_of[last] = grp["id"]
+
+
+def run_group(machine, group: PlacementGroup,
+              params_by_member: List[Dict],
+              inputs_by_member: List[List], train: bool):
+    """Execute a placement group jointly.  Returns, per member, the tuple
+    of its output arrays (each sliced from the group-stacked result, so it
+    physically lives on that member's device block)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from flexflow_tpu.parallel.ring_attention import unchecked_shard_map
+
+    ops = group.members
+    op0 = ops[0]
+    G = group.n_groups
+    axes = op0.AXIS_NAMES
+    mesh = machine.placement_mesh(op0.pc.dims, axes)
+    slots = group.slots
+    k_in = len(op0.input_specs())
+
+    # ---- stack params along the group axis (zeros in unowned blocks) ----
+    have_params = bool(params_by_member and params_by_member[0])
+    if have_params:
+        def stack_leaf(*member_leaves):
+            by = dict(zip(slots, member_leaves))
+            z = jnp.zeros_like(member_leaves[0])
+            return jnp.stack([by.get(g, z) for g in range(G)])
+
+        stacked = jax.tree.map(stack_leaf, *params_by_member)
+        pspecs = {k: P("_pg", *spec)
+                  for k, spec in op0.param_specs().items()}
+    else:
+        stacked = {}
+        pspecs = {}
+
+    in_specs = (pspecs,) + tuple(op0.input_specs()) * len(ops)
+    out_specs = tuple(P("_pg", *spec) for spec in op0.output_specs())
+    flat_inputs = [x for xs in inputs_by_member for x in xs]
+
+    def body(sp, *flat):
+        local_params = jax.tree.map(lambda a: a[0], sp)
+        gidx = lax.axis_index("_pg")
+        xs_by_member = [list(flat[m * k_in:(m + 1) * k_in])
+                        for m in range(len(ops))]
+
+        def branch_for(m):
+            def br(_):
+                res, _st = ops[m].forward(local_params, {},
+                                          xs_by_member[m], train)
+                outs = res if isinstance(res, tuple) else (res,)
+                return tuple(jnp.expand_dims(o, 0) for o in outs)
+            return br
+
+        owned = {g: branch_for(m) for m, g in enumerate(slots)}
+        shapes = jax.eval_shape(owned[slots[0]], 0)
+
+        def zero_branch(_):
+            return tuple(jnp.zeros(s.shape, s.dtype) for s in shapes)
+
+        branches = [owned.get(g, zero_branch) for g in range(G)]
+        return lax.switch(gidx, branches, 0)
+
+    res = unchecked_shard_map(body, mesh, in_specs, out_specs)(
+        stacked, *flat_inputs)
+    return [tuple(r[g] for r in res) for g in slots]

@@ -89,20 +89,24 @@ class MeasuredCostModel:
         self._dirty = 0
         self._warned_kinds = set()
         self._cache: Dict[str, float] = {}
+        # entries written by other timing protocols: never used for lookup,
+        # but preserved verbatim on save so downgrading to an older binary
+        # does not require re-measuring everything
+        self._foreign: Dict[str, float] = {}
         if cache_path and os.path.exists(cache_path):
             with open(cache_path) as f:
                 loaded = json.load(f)
-            # drop entries from other timing protocols so stale keys don't
-            # accumulate in the file across version bumps
             pref = f"v{self._PROTOCOL}|"
-            self._cache = {k: v for k, v in loaded.items()
-                           if k.startswith(pref)}
+            for k, v in loaded.items():
+                (self._cache if k.startswith(pref) else self._foreign)[k] = v
 
     def _save(self, force: bool = False):
         if not self.cache_path or (not force and self._dirty < self.save_every):
             return
+        merged = dict(self._foreign)
+        merged.update(self._cache)
         with open(self.cache_path, "w") as f:
-            json.dump(self._cache, f, indent=1, sort_keys=True)
+            json.dump(merged, f, indent=1, sort_keys=True)
         self._dirty = 0
 
     def flush(self):

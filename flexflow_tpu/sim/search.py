@@ -238,11 +238,20 @@ def _axis_extents(op: Op) -> Dict[str, List[int]]:
 
 
 def candidate_configs(op: Op, num_devices: int,
-                      max_per_axis: Optional[Dict[str, int]] = None
-                      ) -> List[ParallelConfig]:
+                      max_per_axis: Optional[Dict[str, int]] = None,
+                      placement: bool = True) -> List[ParallelConfig]:
     """Power-of-2 grids (the reference constrains the search the same way,
     scripts/simulator.cc:143-151) whose product divides the machine and
-    whose dims divide the tensor extents they partition."""
+    whose dims divide the tensor extents they partition.
+
+    Device maps: the canonical full-prefix list always; additionally, for
+    sub-machine grids the op supports in placed execution
+    (parallel/placement.py), every aligned device BLOCK — the searchable
+    placement dimension of the SOAP space.  The reference randomizes the
+    whole per-op device map (scripts/simulator.cc:224-235); here the
+    candidates are exactly the placements the executor honors, so a
+    searched strategy never claims a placement that would silently degrade
+    to replication."""
     ext = _axis_extents(op)
     axes = op.AXIS_NAMES
     choices_per_axis = []
@@ -258,13 +267,27 @@ def candidate_configs(op: Op, num_devices: int,
             p *= 2
         choices_per_axis.append(opts or [1])
     out = []
+    placeable = placement and op.placement_signature() is not None \
+        and not op.init_state()
+
+    def emit(dims):
+        prod = math.prod(dims)
+        out.append(ParallelConfig(dims, tuple(range(prod))))
+        if not (placeable and prod < num_devices):
+            return
+        pc0 = out[-1]
+        if op.input_specs(pc0) is None:
+            return
+        for g in range(1, num_devices // prod):
+            out.append(ParallelConfig(
+                dims, tuple(range(g * prod, (g + 1) * prod))))
+
     def rec(i, dims, prod):
         if prod > num_devices or num_devices % prod and i == len(axes):
             return
         if i == len(axes):
             if num_devices % prod == 0:
-                out.append(ParallelConfig(tuple(dims),
-                                          tuple(range(prod))))
+                emit(tuple(dims))
             return
         for c in choices_per_axis[i]:
             if prod * c <= num_devices:
@@ -273,7 +296,7 @@ def candidate_configs(op: Op, num_devices: int,
     # dedupe + keep deterministic order; ensure pure-DP present
     uniq = {}
     for pc in out:
-        uniq[pc.dims] = pc
+        uniq[(pc.dims, pc.devices)] = pc
     return list(uniq.values())
 
 
@@ -283,11 +306,16 @@ class StrategySearch:
 
     def __init__(self, model: FFModel, machine: Optional[MachineModel] = None,
                  cost_model=None,
-                 max_per_axis: Optional[Dict[str, int]] = None):
+                 max_per_axis: Optional[Dict[str, int]] = None,
+                 placement: bool = True):
+        """``placement=False`` restricts candidates to canonical device
+        lists (dims-only search, the round-1 behavior) — kept for A/B
+        comparison of the placement dimension's value."""
         self.model = model
         self.machine = machine or model.machine
         self.cost_model = cost_model or AnalyticCostModel()
         self.max_per_axis = max_per_axis
+        self.placement = placement
         self.ops: List[Op] = list(model.layers)
         self._op_index = {}
         for i, op in enumerate(self.ops):
@@ -306,7 +334,8 @@ class StrategySearch:
         pbytes: List[float] = []
         seen_param_keys = set()
         for op in self.ops:
-            cands = candidate_configs(op, n_dev, self.max_per_axis)
+            cands = candidate_configs(op, n_dev, self.max_per_axis,
+                                      placement=self.placement)
             self.candidates.append(cands)
             producers = [self._op_index.get(t.tid, -1) for t in op.inputs]
             ints.append(len(producers))

@@ -2,6 +2,7 @@
 streaming-softmax reference path (interpret mode on the CPU test mesh —
 the identical kernel code compiles via Mosaic on TPU)."""
 
+import contextlib
 import os
 
 import jax
@@ -15,6 +16,22 @@ from flexflow_tpu.parallel.ring_attention import blockwise_attention
 
 def _rand(rng, *shape):
     return jnp.asarray(rng.randn(*shape).astype("float32"))
+
+
+@contextlib.contextmanager
+def flash_env(value="1"):
+    """Set FLEXFLOW_TPU_FLASH for the block, restoring any pre-existing
+    value afterwards (a bare pop would clobber a user-set value for the
+    rest of the session)."""
+    prev = os.environ.get("FLEXFLOW_TPU_FLASH")
+    os.environ["FLEXFLOW_TPU_FLASH"] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("FLEXFLOW_TPU_FLASH", None)
+        else:
+            os.environ["FLEXFLOW_TPU_FLASH"] = prev
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -112,14 +129,11 @@ def test_ring_attention_flash_path(machine8, causal):
     ref = blockwise_attention(q, k, v, causal)
     gref = jax.grad(lambda q, k, v: (blockwise_attention(q, k, v, causal)
                                      ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
-    os.environ["FLEXFLOW_TPU_FLASH"] = "1"
-    try:
+    with flash_env():
         got = ring_attention(q, k, v, mesh, "s", causal)
         gfl = jax.grad(lambda q, k, v: (ring_attention(q, k, v, mesh, "s",
                                                        causal) ** 2).sum(),
                        argnums=(0, 1, 2))(q, k, v)
-    finally:
-        os.environ.pop("FLEXFLOW_TPU_FLASH", None)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
     for a, b in zip(gfl, gref):
@@ -146,11 +160,8 @@ def test_transformer_forward_matches_with_flash_forced(machine8):
         return float(loss)
 
     base = run()
-    os.environ["FLEXFLOW_TPU_FLASH"] = "1"
-    try:
+    with flash_env():
         flashed = run()
-    finally:
-        os.environ.pop("FLEXFLOW_TPU_FLASH", None)
     assert abs(base - flashed) < 1e-4, (base, flashed)
 
 
@@ -201,11 +212,8 @@ def test_lm_head_fusion_matches_unfused(machine8):
         return float(loss)
 
     base = run()
-    os.environ["FLEXFLOW_TPU_FLASH"] = "1"
-    try:
+    with flash_env():
         fused = run()
-    finally:
-        os.environ.pop("FLEXFLOW_TPU_FLASH", None)
     assert abs(base - fused) < 1e-3, (base, fused)
 
 
@@ -228,11 +236,8 @@ def test_lm_head_fusion_grads_match(machine8):
         return jax.tree.leaves(g)
 
     base = grads()
-    os.environ["FLEXFLOW_TPU_FLASH"] = "1"
-    try:
+    with flash_env():
         fused = grads()
-    finally:
-        os.environ.pop("FLEXFLOW_TPU_FLASH", None)
     for a, c in zip(base, fused):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=2e-3, atol=2e-3)
@@ -254,17 +259,14 @@ def test_lm_head_fusion_vocab_tp(machine8):
                        "int32")
 
     def run(fused):
-        if fused:
-            os.environ["FLEXFLOW_TPU_FLASH"] = "1"
-        try:
+        ctx = flash_env() if fused else flash_env("0")
+        with ctx:
             tlm = TransformerLM(tcfg, machine8, s)
             params, state = tlm.init(seed=0)
             loss, _ = tlm.loss_fn(params, state, toks, toks, train=True)
             g = jax.grad(lambda p: tlm.loss_fn(p, state, toks, toks,
                                                train=True)[0])(params)
             return float(loss), jax.tree.leaves(g)
-        finally:
-            os.environ.pop("FLEXFLOW_TPU_FLASH", None)
 
     base_loss, base_g = run(False)
     fused_loss, fused_g = run(True)
