@@ -66,6 +66,7 @@ class MachineModel:
         self._mesh_cache: Dict[Tuple, "jax.sharding.Mesh"] = {}
         self._honored: set = set()
         self._warned: set = set()
+        self._gfactors = None
 
     @classmethod
     def virtual(cls, num_devices: int,
@@ -82,6 +83,7 @@ class MachineModel:
         m._mesh_cache = {}
         m._honored = set()
         m._warned = set()
+        m._gfactors = None
         return m
 
     @property
@@ -140,8 +142,27 @@ class MachineModel:
         """Record that ``pc``'s placement IS honored by an explicit
         execution path (placement-group shard_map), so :meth:`sharding`
         does not warn when asked for this pc's normalized param/fallback
-        sharding."""
+        sharding.  Scope with :meth:`honored_placements` when several
+        models share one machine."""
         self._honored.add((pc.dims, pc.devices))
+
+    def honored_placements(self, pcs):
+        """Context manager scoping the honored-placement set to ``pcs`` —
+        a model's schedule marks only ITS placed configs as honored while
+        it initializes/traces, so a config honored by one model does not
+        suppress the degraded-placement warning for another model sharing
+        this MachineModel."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            old = self._honored
+            self._honored = {(pc.dims, pc.devices) for pc in pcs}
+            try:
+                yield
+            finally:
+                self._honored = old
+        return cm()
 
     def _warn_once(self, key, msg: str) -> None:
         if key in self._warned:
@@ -180,6 +201,158 @@ class MachineModel:
         canonical device assignment."""
         return self.sharding(pc, axis_names, spec)
 
+    # ------------------------------------------------------------------
+    # The global factored mesh: ONE mesh for the whole program.
+    #
+    # Per-op meshes give every op a private device layout; transitions
+    # between them leave GSPMD relating arbitrary tile assignments, and it
+    # punts to "involuntary full rematerialization" (replicate + re-slice)
+    # on anything beyond the simple cases.  Instead the machine is factored
+    # once into prime-sized axes (_g0, _g1, ... in canonical device order)
+    # and every ParallelConfig whose grid dims decompose over those factors
+    # is expressed as a PartitionSpec on this ONE mesh.  Adjacent ops then
+    # differ only in which tensor dim each _gK axis shards, and a grid
+    # change decomposes into single-axis moves (all-to-all), drops
+    # (all-gather) and splits (slice) — see :meth:`regrid_steps`.  This is
+    # the GSPMD analog of the reference's implicit repartitioning between
+    # differently-gridded producers/consumers (conv_2d.cu:171-208).
+
+    def _global_factors(self):
+        """[(axis_name, prime_size), ...] — ascending prime factorization
+        of the machine size, cached."""
+        if self._gfactors is None:
+            n = self.num_devices
+            sizes = []
+            f = 2
+            while f * f <= n:
+                while n % f == 0:
+                    sizes.append(f)
+                    n //= f
+                f += 1
+            if n > 1:
+                sizes.append(n)
+            self._gfactors = [(f"_g{i}", s) for i, s in enumerate(sizes)]
+        return self._gfactors
+
+    def global_mesh(self):
+        """The one shared mesh: shape = prime factorization (ascending),
+        canonical device order."""
+        from jax.sharding import Mesh
+
+        key = ("_global",)
+        mesh = self._mesh_cache.get(key)
+        if mesh is None:
+            fac = self._global_factors()
+            mesh = Mesh(self._dev_array(tuple(s for _, s in fac)),
+                        tuple(nm for nm, _ in fac))
+            self._mesh_cache[key] = mesh
+        return mesh
+
+    def global_assign(self, pc: ParallelConfig,
+                      axis_names: Tuple[str, ...]) -> Optional[Dict]:
+        """{op axis name -> tuple of global mesh axes realizing that grid
+        dim} or None when the grid does not decompose over the factors.
+
+        Grid dim 0 varies fastest over ``pc.devices`` (Rect order), and the
+        global mesh's LAST axis varies fastest in the canonical row-major
+        flatten — so dim 0 consumes factors from the fast end backwards.
+        Within one grid dim the consumed axes are ordered slow-first, which
+        is PartitionSpec's major-to-minor convention.  The induced
+        shard -> device map is then identical to :meth:`mesh_for`'s."""
+        fac = self._global_factors()
+        idx = len(fac)
+        assign: Dict[str, Tuple[str, ...]] = {}
+        for name, g in zip(axis_names, pc.dims):
+            take = []
+            while g > 1:
+                if idx == 0:
+                    return None
+                aname, size = fac[idx - 1]
+                if g % size:
+                    return None
+                idx -= 1
+                take.append(aname)
+                g //= size
+            assign[name] = tuple(reversed(take))
+        return assign
+
+    def global_entries(self, pc: ParallelConfig, axis_names: Tuple[str, ...],
+                       spec, rank: Optional[int] = None) -> Optional[Tuple]:
+        """``spec`` (over op axis names) translated to per-tensor-dim tuples
+        of global mesh axes, padded to ``rank`` dims; None when the machine
+        is trivial or the grid doesn't decompose."""
+        if self.num_devices <= 1:
+            return None
+        assign = self.global_assign(pc, axis_names)
+        if assign is None:
+            return None
+        entries = []
+        for entry in spec:
+            if entry is None:
+                entries.append(())
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            axes = []
+            for nm in names:
+                axes.extend(assign.get(nm, ()))
+            entries.append(tuple(axes))
+        if rank is not None:
+            entries.extend(() for _ in range(rank - len(entries)))
+        return tuple(entries)
+
+    def entries_sharding(self, entries: Tuple):
+        """NamedSharding on the global mesh from per-dim axis tuples."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(
+            self.global_mesh(),
+            PartitionSpec(*[e if e else None for e in entries]))
+
+    def regrid_steps(self, src: Tuple, dst: Tuple) -> Optional[list]:
+        """Decompose the regrid ``src -> dst`` (both global-entry tuples of
+        equal rank) into intermediate shardings such that each hop changes
+        at most one mesh axis: a drop (all-gather), a move between tensor
+        dims (all-to-all), or a split (slice).  GSPMD lowers each hop
+        efficiently where it would full-rematerialize the combined jump.
+        Returns the intermediate entry tuples (excluding ``dst`` itself),
+        or None when the greedy ordering cannot reach ``dst`` (caller then
+        constrains directly — the status quo)."""
+        if len(src) != len(dst):
+            return None
+        if src == dst:
+            return []
+        steps = []
+        cur = [list(t) for t in src]
+        dst_axes = {a for t in dst for a in t}
+        if any(a not in dst_axes for t in cur for a in t):
+            # drop axes that only appear in src (one all-gather hop)
+            cur = [[a for a in t if a in dst_axes] for t in cur]
+            steps.append(tuple(tuple(t) for t in cur))
+        loc = {a: j for j, t in enumerate(cur) for a in t}
+        order = [(j, p, a) for j, t in enumerate(dst)
+                 for p, a in enumerate(t)]
+        done = lambda: all(tuple(t) == d for t, d in zip(cur, dst))
+        progress = True
+        while progress and not done():
+            progress = False
+            for j, p, a in order:
+                if p < len(cur[j]) and cur[j][p] == a:
+                    continue  # already in place
+                if len(cur[j]) != p or tuple(cur[j]) != dst[j][:p]:
+                    continue  # destination prefix not ready yet
+                if a in loc:
+                    cur[loc[a]].remove(a)   # move: one all-to-all
+                # else: pure split — slice, no data exchange
+                cur[j].append(a)
+                loc[a] = j
+                steps.append(tuple(tuple(t) for t in cur))
+                progress = True
+        if not done():
+            return None
+        if steps and steps[-1] == tuple(tuple(t) for t in dst):
+            steps.pop()  # caller applies dst itself
+        return steps
+
     def sharding(self, pc: ParallelConfig, axis_names: Tuple[str, ...], spec):
         """NamedSharding for ``pc`` with partition ``spec`` over the grid's
         axis names.
@@ -195,6 +368,9 @@ class MachineModel:
 
         n_parts = pc.num_parts
         if self.is_canonical(pc):
+            entries = self.global_entries(pc, axis_names, spec)
+            if entries is not None:
+                return self.entries_sharding(entries)
             return NamedSharding(self.mesh_for(pc, axis_names), spec)
         if self.num_devices % n_parts != 0:
             # grid doesn't divide the machine (non-power-of-2 corner):
@@ -214,11 +390,15 @@ class MachineModel:
                 f"parallel/placement.py for the supported forms)")
         # Normalized realization: XLA admits exactly one device assignment
         # per computation, so a permuted/subset device list is mapped onto
-        # the canonical order, with a leading `_repl` mesh axis replicating
-        # over the devices the grid doesn't occupy.  Under SPMD every chip
-        # participates in every op regardless — this matches how the
-        # reference's CNN mapper treats devices[] (round-robin over the
-        # grid, cnn_mapper.cc:43-82).
+        # the canonical order, with the devices the grid doesn't occupy
+        # holding replicas.  Under SPMD every chip participates in every op
+        # regardless — this matches how the reference's CNN mapper treats
+        # devices[] (round-robin over the grid, cnn_mapper.cc:43-82).
+        entries = self.global_entries(pc, axis_names, spec)
+        if entries is not None:
+            # on the global mesh the unconsumed (slow) axes simply don't
+            # appear in the spec — same replication, one shared mesh
+            return self.entries_sharding(entries)
         key = (pc.dims, axis_names, "_norm")
         mesh = self._mesh_cache.get(key)
         if mesh is None:
@@ -234,6 +414,8 @@ class MachineModel:
         """Fully-replicated sharding over all devices."""
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+        if self.num_devices > 1:
+            return NamedSharding(self.global_mesh(), PartitionSpec())
         return NamedSharding(
             self.mesh_for(
                 ParallelConfig((self.num_devices,),

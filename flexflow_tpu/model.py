@@ -206,7 +206,8 @@ class FFModel:
                         # deterministic all-ones weights, hand-checkable runs
                         p = {k: jnp.ones_like(v) for k, v in p.items()}
                 if p:
-                    shardings = op.param_shardings(self.machine)
+                    with self._honored_ctx():
+                        shardings = op.param_shardings(self.machine)
                     if abstract:
                         params[op.param_key] = {
                             k: jax.ShapeDtypeStruct(v.shape, v.dtype,
@@ -241,12 +242,13 @@ class FFModel:
         """{param_key: {name: sharding}} mirroring ``params`` — the same
         shardings init() placed them with."""
         shardings = {}
-        for op in self.layers:
-            if op.param_key in params and op.param_key not in shardings:
-                sh = op.param_shardings(self.machine)
-                shardings[op.param_key] = {
-                    k: sh[k] for k in params[op.param_key]
-                }
+        with self._honored_ctx():
+            for op in self.layers:
+                if op.param_key in params and op.param_key not in shardings:
+                    sh = op.param_shardings(self.machine)
+                    shardings[op.param_key] = {
+                        k: sh[k] for k in params[op.param_key]
+                    }
         return shardings
 
     def _constrain_params(self, new_params, shardings):
@@ -400,8 +402,10 @@ class FFModel:
 
     def _placement_schedule(self, exclude: frozenset):
         """Dataflow schedule with explicit-placement groups (cached per
-        fusion-exclusion set).  Marks grouped pcs as honored so
-        MachineModel.sharding does not warn about their param fallback."""
+        fusion-exclusion set).  Grouped pcs are recorded as THIS model's
+        honored placements (scoped via machine.honored_placements, so a
+        shared MachineModel does not suppress degraded-placement warnings
+        across models)."""
         cached = getattr(self, "_sched_cache", None)
         if cached is not None and cached[0] == exclude:
             return cached[1]
@@ -410,16 +414,25 @@ class FFModel:
 
         sched = plan_schedule(self.layers, self.machine.num_devices,
                               exclude=exclude)
+        pcs = list(getattr(self, "_honored_pcs", ()))
         for entry in sched:
             if isinstance(entry, PlacementGroup):
-                for m in entry.members:
-                    self.machine.note_honored(m.pc)
+                pcs.extend(m.pc for m in entry.members)
+        self._honored_pcs = pcs
         self._sched_cache = (exclude, sched)
         return sched
+
+    def _honored_ctx(self):
+        return self.machine.honored_placements(
+            getattr(self, "_honored_pcs", ()))
 
     def apply(self, params, state, inputs: Dict[int, Any], train: bool):
         """Run the DAG. ``inputs`` maps input-Tensor tid -> array.
         Returns (tensor-values dict, new_state)."""
+        with self._honored_ctx():
+            return self._apply(params, state, inputs, train)
+
+    def _apply(self, params, state, inputs: Dict[int, Any], train: bool):
         from jax import lax
 
         from flexflow_tpu.parallel.placement import (PlacementGroup,
@@ -434,6 +447,18 @@ class FFModel:
             schedule = range(len(self.layers))
         values: Dict[int, Any] = dict(inputs)
         new_state: Dict[str, Dict] = {}
+        # tid -> global-mesh entry tuple of each produced value, for
+        # decomposing producer->consumer regrids (see _regrid_inputs);
+        # model inputs arrive batch-sharded over the whole machine (the
+        # loaders' convention, data/synthetic.py)
+        specs: Dict[int, Any] = {}
+        if multi:
+            dp = ParallelConfig.data_parallel(1, self.machine.num_devices)
+            from jax.sharding import PartitionSpec as P
+
+            for t in self._inputs:
+                specs[t.tid] = self.machine.global_entries(
+                    dp, ("n",), P("n"), rank=t.ndim)
         for entry in schedule:
             if isinstance(entry, PlacementGroup):
                 outs_by_member = run_group(
@@ -457,6 +482,8 @@ class FFModel:
                     values[op.labels_tensor.tid])
                 continue
             xs = [values[t.tid] for t in op.inputs]
+            if multi:
+                xs = self._regrid_inputs(op, xs, specs)
             res, st = op.forward(params.get(op.param_key, {}),
                                  state.get(op.name, {}), xs, train)
             ys = res if isinstance(res, tuple) else (res,)
@@ -464,12 +491,47 @@ class FFModel:
                 if multi and spec is not None:
                     y = lax.with_sharding_constraint(
                         y, self.machine.sharding(op.pc, op.AXIS_NAMES, spec))
+                    specs[t.tid] = self.machine.global_entries(
+                        op.pc, op.AXIS_NAMES, spec, rank=t.ndim)
                 if dump:
                     print_tensor(f"{op.name}/{t.name or 'out'}", y)
                 values[t.tid] = y
             if st:
                 new_state[op.name] = st
         return values, new_state
+
+    def _regrid_inputs(self, op, xs, specs):
+        """Re-shard ``op``'s inputs to the layout its compute wants, as a
+        chain of single-mesh-axis hops (MachineModel.regrid_steps) from each
+        producer's recorded layout.  GSPMD lowers each hop as an
+        all-to-all / all-gather / slice where the combined jump would
+        trigger involuntary full rematerialization.  The reference relies on
+        Legion for the same producer/consumer repartitioning
+        (conv_2d.cu:171-208)."""
+        from jax import lax
+
+        want = op.regrid_input_specs()
+        if want is None:
+            return xs
+        out = []
+        for x, t, spec in zip(xs, op.inputs, want):
+            if spec is None:
+                out.append(x)
+                continue
+            dst = self.machine.global_entries(op.pc, op.AXIS_NAMES, spec,
+                                              rank=t.ndim)
+            src = specs.get(t.tid)
+            if dst is None or dst == src:
+                out.append(x)
+                continue
+            if src is not None:
+                for step in self.machine.regrid_steps(src, dst) or []:
+                    x = lax.with_sharding_constraint(
+                        x, self.machine.entries_sharding(step))
+            x = lax.with_sharding_constraint(
+                x, self.machine.entries_sharding(dst))
+            out.append(x)
+        return out
 
     def loss_fn(self, params, state, image, labels, train: bool = True):
         loss_op = self._loss_op()

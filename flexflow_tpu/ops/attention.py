@@ -96,6 +96,20 @@ class MultiHeadAttention(Op):
         else:
             out = self._flash_or_blockwise(q, k, v, s)
         out = out.astype(x.dtype).transpose(0, 2, 1, 3).reshape(b, s, d)
+        if (self.machine is not None and self.machine.num_devices > 1
+                and self.pc.dims[1] > 1):
+            # head TP: keep the merged activation head-sharded along d so
+            # the wo projection is row-parallel (contraction dim sharded,
+            # GSPMD psums partial products — the Megatron pair to the
+            # column-parallel q/k/v).  Without this the activation arrives
+            # batch-sharded and the wo weight-grad dot forces a
+            # full-rematerialization reshard in the backward pass.
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            out = lax.with_sharding_constraint(
+                out, self.machine.sharding(self.pc, self.AXIS_NAMES,
+                                           P("n", "s", "h")))
         y = jnp.einsum("bsd,de->bse", out, params["wo"].astype(x.dtype),
                        preferred_element_type=jnp.float32).astype(x.dtype)
         return y + params["bo"].astype(x.dtype), state

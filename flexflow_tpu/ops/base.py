@@ -131,6 +131,15 @@ class Op:
         match.  None -> op does not support placed execution."""
         return None
 
+    def regrid_input_specs(self):
+        """PartitionSpec per input (over AXIS_NAMES, under ``self.pc``)
+        that this op's compute wants its inputs in — used by FFModel.apply
+        to decompose producer->consumer grid changes into single-axis-move
+        resharding steps GSPMD lowers without full rematerialization (the
+        reference's implicit repartitioning, conv_2d.cu:171-208).  None ->
+        no preference (GSPMD chooses); a None entry skips that input."""
+        return None
+
     def output_sharding(self, machine):
         return machine.sharding(self.pc, self.AXIS_NAMES, self.output_spec())
 

@@ -38,6 +38,13 @@ class Concat(Op):
             return None  # channel-split would break the local concat
         return [P("n", "h", "w", None) for _ in self.inputs]
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        # channel-dim concat: per-input channel counts need not divide the
+        # 'c' grid, so inputs arrive channel-replicated
+        return [P("n", "h", "w", None)] * len(self.inputs)
+
     def placement_signature(self):
         return ("concat", len(self.inputs))
 

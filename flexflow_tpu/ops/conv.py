@@ -62,6 +62,14 @@ class Conv2D(Op):
                 self.kernel_w, self.stride_h, self.stride_w,
                 self.padding_h, self.padding_w, self.relu)
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        # input channels are never split (the grid's c splits OUTPUT
+        # channels, conv_2d.cu:72): replicated over 'c', spatial/batch per
+        # the own grid (XLA adds halo exchanges for the h/w shards)
+        return [P("n", "h", "w", None)]
+
     def init_params(self, rng) -> Dict:
         import jax
 

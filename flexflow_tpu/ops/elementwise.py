@@ -37,6 +37,11 @@ class Add(Op):
         # elementwise: any inner grid is local when both inputs share it
         return [P("n", "h", "w", "c"), P("n", "h", "w", "c")]
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", "h", "w", "c")] * len(self.inputs)
+
     def placement_signature(self):
         return (self.relu,)
 

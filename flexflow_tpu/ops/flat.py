@@ -44,6 +44,11 @@ class Flat(Op):
     def placement_signature(self):
         return ("flat",)
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None, None, None)]
+
     def forward(self, params, state, xs: List, train: bool):
         (x,) = xs
         return x.reshape(x.shape[0], -1), state

@@ -63,6 +63,11 @@ class Linear(Op):
     def placement_signature(self):
         return (self.in_channels, self.out_channels, self.relu)
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None)]
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
         import jax.numpy as jnp

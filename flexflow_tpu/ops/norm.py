@@ -54,6 +54,11 @@ class BatchNorm(Op):
 
         return P("n", "h", "w", "c")
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", "h", "w", "c")]
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
         import jax.numpy as jnp

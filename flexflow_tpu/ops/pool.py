@@ -52,6 +52,11 @@ class Pool2D(Op):
         return (self.kernel_h, self.kernel_w, self.stride_h, self.stride_w,
                 self.padding_h, self.padding_w, self.pool_type, self.relu)
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", "h", "w", "c")]
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
         import jax.numpy as jnp

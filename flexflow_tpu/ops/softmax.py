@@ -37,6 +37,11 @@ class Softmax(Op):
 
         return P("n", None)
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None)]
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
 

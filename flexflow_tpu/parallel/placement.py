@@ -277,4 +277,20 @@ def run_group(machine, group: PlacementGroup,
 
     res = unchecked_shard_map(body, mesh, in_specs, out_specs)(
         stacked, *flat_inputs)
-    return [tuple(r[g] for r in res) for g in slots]
+    # Constrain each sliced member output to its pc's normalized sharding
+    # (grid over the fast global axes, replicated over the rest).  This
+    # splits the stacked->consumer regrid into an explicit gather over the
+    # group axis plus a free slice; without the waypoint GSPMD relates the
+    # stacked layout to the consumer's (e.g. full-DP) layout in one jump
+    # and falls back to involuntary full rematerialization in the backward.
+    out = []
+    for g, m in zip(slots, ops):
+        vals = []
+        for r, spec in zip(res, op0.output_specs()):
+            v = r[g]
+            if spec is not None:
+                v = lax.with_sharding_constraint(
+                    v, machine.sharding(m.pc, m.AXIS_NAMES, spec))
+            vals.append(v)
+        out.append(tuple(vals))
+    return out

@@ -1,0 +1,138 @@
+"""The global factored mesh + single-axis-move regrid decomposition.
+
+Round-2 fix for the involuntary-full-rematerialization regrids GSPMD emits
+when per-op meshes meet (VERDICT.md round-1 item 3).  Every decomposable
+ParallelConfig is expressed on ONE prime-factored mesh
+(MachineModel.global_mesh), and producer->consumer grid changes are chained
+through intermediate shardings that each change a single mesh axis
+(MachineModel.regrid_steps) — the GSPMD analog of the reference's implicit
+repartitioning between differently-gridded ops (conv_2d.cu:171-208)."""
+
+import jax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+CNN_AXES = ("w", "h", "c", "n")
+
+
+def all8():
+    return tuple(range(8))
+
+
+class TestGlobalAssign:
+    def test_factors(self):
+        m = MachineModel.virtual(8)
+        assert [s for _, s in m._global_factors()] == [2, 2, 2]
+        m12 = MachineModel.virtual(12)
+        assert [s for _, s in m12._global_factors()] == [2, 2, 3]
+
+    def test_assign_dim0_fastest(self):
+        m = MachineModel.virtual(8)
+        a = m.global_assign(ParallelConfig((2, 2, 1, 2), all8()), CNN_AXES)
+        # grid dim 0 (w) varies fastest over devices -> last (fastest) axis
+        assert a == {"w": ("_g2",), "h": ("_g1",), "c": (), "n": ("_g0",)}
+
+    def test_assign_multi_factor_dim(self):
+        m = MachineModel.virtual(8)
+        a = m.global_assign(ParallelConfig((4, 2), all8()), ("c", "n"))
+        assert a == {"c": ("_g1", "_g2"), "n": ("_g0",)}
+
+    def test_subset_pc_leaves_slow_axes(self):
+        m = MachineModel.virtual(8)
+        a = m.global_assign(ParallelConfig((4,), (0, 1, 2, 3)), ("n",))
+        assert a == {"n": ("_g1", "_g2")}  # _g0 left replicated
+
+    def test_non_decomposable(self):
+        m = MachineModel.virtual(12)  # factors (2,2,3); dim0=4 needs 3 first
+        assert m.global_assign(ParallelConfig(
+            (4, 3), tuple(range(12))), ("c", "n")) is None
+
+
+class TestGlobalShardingEquivalence:
+    """Global-mesh shardings place shards on exactly the same devices as the
+    legacy per-op meshes — the ParallelConfig semantics are unchanged."""
+
+    @pytest.mark.parametrize("dims,axes,spec", [
+        ((2, 2, 1, 2), CNN_AXES, P("n", "h", "w", "c")),
+        ((1, 1, 4, 2), CNN_AXES, P("n", "h", "w", "c")),
+        ((1, 1, 1, 8), CNN_AXES, P("n", "h", "w", "c")),
+        ((4, 2), ("c", "n"), P("n", "c")),
+        ((2, 4), ("c", "n"), P("n", "c")),
+        ((8,), ("n",), P("n")),
+        ((4, 1, 2), ("s", "h", "n"), P("n", "s", None)),
+    ])
+    def test_equivalent(self, machine8, dims, axes, spec):
+        pc = ParallelConfig(dims, all8())
+        new = machine8.sharding(pc, axes, spec)
+        legacy = NamedSharding(machine8.mesh_for(pc, axes), spec)
+        assert new.is_equivalent_to(legacy, len(list(spec)))
+
+    def test_all_on_one_mesh(self, machine8):
+        a = machine8.sharding(ParallelConfig((2, 2, 1, 2), all8()),
+                              CNN_AXES, P("n", "h", "w", "c"))
+        b = machine8.sharding(ParallelConfig((4, 2), all8()),
+                              ("c", "n"), P("n", "c"))
+        assert a.mesh is b.mesh
+        assert machine8.replicated().mesh is a.mesh
+
+
+class TestRegridSteps:
+    def test_identity(self):
+        m = MachineModel.virtual(8)
+        e = (("_g0",), ("_g1",), ("_g2",), ())
+        assert m.regrid_steps(e, e) == []
+
+    def test_spatial_to_batch_two_moves(self):
+        m = MachineModel.virtual(8)
+        src = (("_g0",), ("_g1",), ("_g2",), ())   # n,h,w sharded
+        dst = (("_g0", "_g1", "_g2"), (), (), ())  # pure batch
+        steps = m.regrid_steps(src, dst)
+        # one intermediate (move _g1 h->n); the final move is the dst itself
+        assert steps == [(("_g0", "_g1"), (), ("_g2",), ())]
+
+    def test_drop_then_move(self):
+        m = MachineModel.virtual(8)
+        src = (("_g0",), ("_g1", "_g2"))   # linear (4,2): n x c
+        dst = (("_g0", "_g1"), ())         # next linear wants batch only
+        steps = m.regrid_steps(src, dst)
+        assert steps == [(("_g0",), ("_g1",))]  # gather _g2 first
+
+    def test_each_step_changes_one_axis(self):
+        m = MachineModel.virtual(8)
+        src = (("_g0",), ("_g1",), ("_g2",), ())
+        dst = (("_g0", "_g1", "_g2"), (), (), ())
+        chain = [src] + m.regrid_steps(src, dst) + [dst]
+        for a, b in zip(chain, chain[1:]):
+            moved = sum(set(x) != set(y) for x, y in zip(a, b))
+            assert moved <= 2  # one axis leaves one dim, enters another
+
+    def test_unreachable_returns_none(self):
+        m = MachineModel.virtual(8)
+        # order inversion within a dim is not expressible by append-only moves
+        assert m.regrid_steps(
+            (("_g1", "_g0"), ()), (("_g0", "_g1"), ())) is None
+
+
+class TestNoInvoluntaryRemat:
+    """Compiling the hybrid-strategy train step (the dryrun_multichip CNN:
+    spatial + channel-TP + linear-TP) must not trip GSPMD's involuntary
+    full rematerialization fallback.  capfd sees the C++ glog output."""
+
+    def test_hybrid_cnn_compiles_clean(self, machine8, capfd):
+        import __graft_entry__ as ge
+
+        devs = all8()
+        s = Strategy()
+        s["conv1"] = ParallelConfig((2, 2, 1, 2), devs)
+        s["conv2"] = ParallelConfig((1, 1, 4, 2), devs)
+        s["linear1"] = ParallelConfig((4, 2), devs)
+        s["linear2"] = ParallelConfig((2, 4), devs)
+        ff, cfg = ge._tiny_model(machine8, s)
+        image = jax.ShapeDtypeStruct((cfg.batch_size, 32, 32, 3), "float32")
+        labels = jax.ShapeDtypeStruct((cfg.batch_size,), "int32")
+        ff.compile_train_step(image, labels)
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" not in err
