@@ -28,7 +28,10 @@
 //     intra_bw, cross_bw, latency,          (bytes/sec, sec)
 //     per op: param_bytes,
 //     per op, per config: compute_cost,     (sec, fwd+bwd per step)
-//     per op, per config: param_replicas    (gradient copies to merge)
+//     per op, per config: param_replicas,   (gradient copies to merge)
+//     per op, per config: collective_cost   (sec; in-op collectives — ring
+//                                            rotation, MoE all-to-all, TP
+//                                            grad all-reduce; sim/collectives.py)
 
 #include <cstdint>
 #include <cstdlib>
@@ -74,6 +77,7 @@ struct Config {
   std::vector<Point> points;
   double compute_cost = 0.0;
   double param_replicas = 1.0;
+  double collective_cost = 0.0;
 };
 
 struct OpNode {
@@ -157,8 +161,9 @@ struct Simulator {
           if (t > ready[x.dst_point]) ready[x.dst_point] = t;
         }
       }
-      // per-shard compute, serialized per device by list scheduling
-      double per_point = cfg.compute_cost;
+      // per-shard compute + in-op collective time, serialized per device
+      // by list scheduling
+      double per_point = cfg.compute_cost + cfg.collective_cost;
       finish[o].resize(np);
       for (size_t j = 0; j < np; j++) {
         int d = cfg.points[j].device;
@@ -252,6 +257,8 @@ void* ffsim_create(const int64_t* ints, int64_t n_ints, const double* dbls,
     for (auto& cfg : sim->ops[o].configs) cfg.compute_cost = *dp++;
   for (int64_t o = 0; o < n_ops; o++)
     for (auto& cfg : sim->ops[o].configs) cfg.param_replicas = *dp++;
+  for (int64_t o = 0; o < n_ops; o++)
+    for (auto& cfg : sim->ops[o].configs) cfg.collective_cost = *dp++;
   return sim;
 }
 

@@ -1,0 +1,122 @@
+"""Collective-communication costs for the simulator.
+
+Round-1 gap (VERDICT.md item 3/#4): ops whose parallelism is realized by
+collectives *inside* the op — ring-attention K/V rotation, the MoE token
+all-to-all, TP activation-gradient all-reduces, the vocab-TP fused-CE
+statistic merge — were exempted from producer->consumer comm edges
+(sim/search.py op_geometry says "rides ICI links") and then never charged
+anywhere, systematically biasing the search toward CP/EP/TP.  The reference
+charges every byte it models (scripts/simulator.cc:898-908 for transfers,
+:513-544 for update costs).
+
+This module prices those in-op collectives analytically, per shard per
+training step (fwd+bwd, matching the compute-cost convention of
+3x-forward), using the machine Topology's two-tier bandwidths.  The result
+is added to each (op, candidate) compute cost in the native simulator.
+
+Conventions:
+  * 4 bytes/element, matching the xfer costing in native/simulator.cc;
+  * ring all-reduce of V bytes over p devices: 2*(p-1)/p * V / bw;
+  * all-to-all of V bytes over p devices: (p-1)/p * V / bw;
+  * backward is charged as 2x the forward collective volume (mirror
+    collectives for the gradients of both operands), so one step = 3x.
+"""
+
+from __future__ import annotations
+
+import math
+
+from flexflow_tpu.machine import Topology
+from flexflow_tpu.ops.base import Op
+from flexflow_tpu.strategy import ParallelConfig
+
+BYTES = 4.0
+
+
+def _bw(topo: Topology, pc: ParallelConfig) -> float:
+    """Bandwidth tier of the slowest link inside pc's device set: ICI when
+    the set stays within one group, DCN when it spans groups (the reference's
+    intra/cross-node split, scripts/simulator.cc:898-908)."""
+    groups = {d // topo.devices_per_ici_group for d in pc.devices}
+    return topo.ici_bandwidth if len(groups) <= 1 else topo.dcn_bandwidth
+
+
+def _allreduce(vol_bytes: float, p: int, bw: float, lat: float) -> float:
+    if p <= 1 or vol_bytes <= 0:
+        return 0.0
+    return 2.0 * (p - 1) / p * vol_bytes / bw + 2.0 * (p - 1) * lat
+
+
+def _alltoall(vol_bytes: float, p: int, bw: float, lat: float) -> float:
+    if p <= 1 or vol_bytes <= 0:
+        return 0.0
+    return (p - 1) / p * vol_bytes / bw + (p - 1) * lat
+
+
+def collective_cost(op: Op, pc: ParallelConfig, topo: Topology) -> float:
+    """Seconds of in-op collective time ONE shard spends per training step
+    under ``pc``.  Zero for ops/configs whose sharding needs no in-op
+    collectives (their cross-shard traffic is the producer->consumer edges
+    the simulator already derives)."""
+    kind = type(op).__name__
+    bw = _bw(topo, pc)
+    lat = topo.ici_latency if bw == topo.ici_bandwidth else topo.dcn_latency
+
+    if kind == "MultiHeadAttention":
+        ps, ph, pn = pc.dims
+        n, s, d = op.output.shape
+        t = 0.0
+        if ps > 1:
+            # ring CP: each of (ps-1) steps rotates this shard's K and V
+            # blocks to the neighbor; backward re-rotates K/V and
+            # additionally rotates dK/dV accumulators -> 3x forward volume
+            kv_block = 2.0 * BYTES * n * s * d / (pn * ps * ph)
+            t += 3.0 * (ps - 1) * (kv_block / bw + lat)
+        if ph > 1:
+            # head TP (Megatron pair): fwd all-reduce of the row-parallel
+            # wo partial products; bwd all-reduce of dL/dx from the
+            # column-parallel q/k/v -> 2 all-reduces of the activation
+            act = BYTES * n * s * d / pn
+            t += 2.0 * _allreduce(act, ph, bw, lat)
+        return t
+
+    if kind == "MixtureOfExperts":
+        pe, pcc, pn = pc.dims
+        t = 0.0
+        n, s, d = op.output.shape
+        if pe > 1:
+            # EP token all-to-all: dispatched tensor (E, B/pn, C, d) leaves
+            # (pe-1)/pe of its slots; once to dispatch + once to combine in
+            # forward, mirrored in backward -> 3x the 2-way volume
+            disp = BYTES * op.num_experts * op.capacity * d * n / pn
+            t += 3.0 * 2.0 * _alltoall(disp, pe, bw, lat)
+        if pcc > 1:
+            # expert-channel TP: all-reduce of the expert outputs (fwd) and
+            # of dL/dx (bwd) over the c shards
+            act = BYTES * op.num_experts * op.capacity * d * n / pn
+            t += 2.0 * _allreduce(act, pcc, bw, lat)
+        return t
+
+    if kind in ("Linear", "RnnLinear"):
+        pcc, pn = pc.dims
+        if pcc <= 1:
+            return 0.0
+        # column-parallel weights: dL/dx needs the cross-c-shard sum (the
+        # reference's replica regions + BWD2 task, linear.cu:570-603) — an
+        # all-reduce of this shard's input-gradient block.  The vocab-TP
+        # fused-CE statistic merge (2 floats/token, model.py
+        # _run_fused_lm_head) rides the same all-reduce and is dominated by
+        # it; charged together here.
+        in_bytes = BYTES * op.inputs[0].size() / pn
+        return _allreduce(in_bytes, pcc, bw, lat)
+
+    if kind == "Conv2D":
+        pw, ph_, pcc, pn = pc.dims
+        if pcc <= 1:
+            return 0.0
+        # output-channel TP: input is replicated over c (fwd broadcast is
+        # a producer->consumer edge already); bwd dL/dx all-reduces over c
+        in_bytes = BYTES * op.inputs[0].size() / (pn * ph_ * pw)
+        return _allreduce(in_bytes, pcc, bw, lat)
+
+    return 0.0

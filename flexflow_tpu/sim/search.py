@@ -21,6 +21,7 @@ import numpy as np
 from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.sim.collectives import collective_cost
 from flexflow_tpu.sim.cost_model import AnalyticCostModel
 from flexflow_tpu.sim.native import NativeSimulator
 from flexflow_tpu.strategy import ParallelConfig, Strategy
@@ -166,8 +167,8 @@ def _point_geometry(op: Op, kind: str, dims, idx):
         n, l, d = op.output.shape
         out = _rect(_split(n, pn, in_), _split(l, ps, is_),
                     _split(d, ph, ih))
-        # ring attention: each shard consumes its own s-slice of x (K/V
-        # rotation cost rides neighbor links, not producer->consumer edges)
+        # ring attention: each shard consumes its own s-slice of x; the K/V
+        # rotation is an in-op collective charged by sim/collectives.py
         tn, tl, td = op.inputs[0].shape
         return out, [_rect(_split(tn, pn, in_), _split(tl, ps, is_),
                            (0, td))]
@@ -178,8 +179,8 @@ def _point_geometry(op: Op, kind: str, dims, idx):
         nlo, nhi = _split(n, pn, in_)
         # The MoE output is n-sharded and replicated over (e, c); one
         # representative point per n-shard carries the data (and consumes
-        # the input n-shard) — the internal token all-to-all rides ICI
-        # links, not producer->consumer edges (same treatment as ring
+        # the input n-shard) — the internal token all-to-all is an in-op
+        # collective charged by sim/collectives.py (same treatment as ring
         # attention above).
         if ie == 0 and ic == 0:
             out = _rect((nlo, nhi), (0, l), (0, d))
@@ -331,6 +332,7 @@ class StrategySearch:
         ints: List[int] = [n_dev, topo.devices_per_ici_group, len(self.ops)]
         costs: List[float] = []
         replicas: List[float] = []
+        colls: List[float] = []
         pbytes: List[float] = []
         seen_param_keys = set()
         for op in self.ops:
@@ -352,6 +354,7 @@ class StrategySearch:
                         ints.extend(r)
                 costs.append(self.cost_model.op_cost(op, pc))
                 replicas.append(self._param_replicas(op, pc))
+                colls.append(collective_cost(op, pc, topo))
             # shared weights (param_key) are synced once per step, not once
             # per chunk op — charge the first op carrying the key
             if op.param_key in seen_param_keys:
@@ -365,6 +368,7 @@ class StrategySearch:
         dbls.extend(pbytes)
         dbls.extend(costs)
         dbls.extend(replicas)
+        dbls.extend(colls)
         self.sim = NativeSimulator(ints, dbls, len(self.ops))
 
     @staticmethod

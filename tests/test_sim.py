@@ -89,7 +89,8 @@ def test_simulator_analytic_schedule():
     dbls = [bw, bw, 0.0,          # intra, cross, latency
             0.0, 0.0,             # param bytes
             1.0, 2.0, 2.0,        # costs: op0 cfg0; op1 cfgA, cfgB
-            1.0, 1.0, 1.0]        # replicas
+            1.0, 1.0, 1.0,        # replicas
+            0.0, 0.0, 0.0]        # in-op collective costs
     sim = NativeSimulator(ints, dbls, 2)
     t_aligned = sim.simulate([0, 0])
     assert abs(t_aligned - 3.0) < 1e-9
