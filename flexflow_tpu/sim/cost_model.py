@@ -46,6 +46,26 @@ def shard_flops(op: Op, pc: ParallelConfig) -> float:
     return 3.0 * op.flops_per_sample() * batch / pc.num_parts
 
 
+def param_shard_fraction(op: Op, pc: ParallelConfig) -> float:
+    """Fraction of the op's parameters ONE shard holds/streams under
+    ``pc``: 1 / (product of grid dims over axes the param specs shard)."""
+    specs = op.param_specs()
+    if not specs:
+        return 1.0
+    shard_axes = set()
+    for spec in specs.values():
+        for entry in spec:
+            if entry is None:
+                continue
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                shard_axes.add(a)
+    sizes = dict(zip(op.AXIS_NAMES, pc.dims))
+    shard = 1
+    for a in shard_axes:
+        shard *= sizes.get(a, 1)
+    return 1.0 / shard
+
+
 class AnalyticCostModel:
     """Roofline: shard time = max(flops / eff_peak, bytes / eff_hbm), with
     fwd+bwd modeled as 3x forward (two extra GEMMs per matmul in backward —
@@ -59,7 +79,13 @@ class AnalyticCostModel:
         flops = shard_flops(op, pc)
         io_elems = sum(t.size() for t in op.inputs) + \
             sum(t.size() for t in op.all_outputs())
-        bytes_moved = 3.0 * 4.0 * io_elems / n_parts + op.param_bytes()
+        # params stream 3x per step too (fwd read, dL/dW accumulate, dL/dx
+        # re-read) — dominant for big-FC shards at small per-shard batch
+        # (measured: the 9216x4096 FC at batch 64 costs ~the full-batch
+        # op); each shard streams only ITS slice of a grid-sharded weight
+        bytes_moved = 3.0 * (4.0 * io_elems / n_parts
+                             + op.param_bytes()
+                             * param_shard_fraction(op, pc))
         p = self.perf
         eff = p.matmul_efficiency if type(op).__name__ in _MATMUL_OPS \
             else p.vector_efficiency
@@ -77,7 +103,7 @@ class MeasuredCostModel:
 
     def __init__(self, cache_path: Optional[str] = None,
                  fallback: Optional[AnalyticCostModel] = None,
-                 repeats: int = 3, chain: int = 8, save_every: int = 32):
+                 repeats: int = 5, chain: int = 8, save_every: int = 32):
         """``repeats`` = timed invocations (min taken); ``chain`` = op
         applications dependency-chained inside each invocation (amortizes
         the tunnel's dispatch latency, see _measure)."""
@@ -119,15 +145,35 @@ class MeasuredCostModel:
         t = self._measure(op, pc)
         if t is None:
             t = self.fallback.op_cost(op, pc)
+        else:
+            # Sanity guard against tunnel-jitter spikes: a measurement far
+            # outside the analytic roofline's plausibility band is
+            # re-measured once.  A spike on the t_2K run inflates the
+            # slope, on the t_K run it DEFLATES it, so keep whichever of
+            # the two medians is closer to the analytic prediction (in log
+            # space), then clamp to 10x either way — honest measurements
+            # land within ~0.25-2.6x of analytic.
+            import math
+
+            a = self.fallback.op_cost(op, pc)
+            if not (a / 5.0 <= t <= a * 5.0):
+                t2 = self._measure(op, pc)
+                if t2 is not None and t2 > 0:
+                    t = min((t, t2), key=lambda v: abs(math.log(v / a)))
+                t = min(max(t, a / 10.0), a * 10.0)
         self._cache[key] = t
         self._dirty += 1
         self._save()
         return t
 
-    # bumped when the timing protocol changes (v2 = chained-scan + host
-    # readback; v1 per-call timers read dispatch latency on tunneled TPUs),
-    # so stale on-disk caches are never silently mixed with new timings
-    _PROTOCOL = 2
+    # bumped when the timing protocol changes (v3 = two-length chained-scan
+    # DIFFERENCING: cost = (t_2K - t_K)/K, cancelling the tunnel's fixed
+    # per-dispatch overhead that v2's single chain only divided by K — on
+    # the tunneled chip that overhead is ~10-15 ms, flattening every op to
+    # the same cost and erasing the partitioning signal the search needs;
+    # v1 per-call timers read pure dispatch latency), so stale on-disk
+    # caches are never silently mixed with new timings
+    _PROTOCOL = 3
 
     def _key(self, op: Op, pc: ParallelConfig) -> str:
         shapes = [t.shape for t in op.inputs] + [op.output.shape]
@@ -150,12 +196,15 @@ class MeasuredCostModel:
                   for t in local.inputs]
             state = local.init_state()
 
-            # Timing protocol: on the tunneled TPU, block_until_ready does
-            # NOT reliably synchronize, so a naive per-call timer reads
-            # dispatch latency, not compute.  Instead CHAIN apps inside one
-            # jitted lax.scan (each iteration's output feeds the next
-            # iteration's input) and force one host readback at the end —
-            # the only honest clock on this platform.
+            # Timing protocol v3: on the tunneled TPU, block_until_ready
+            # does NOT reliably synchronize and each dispatch carries a
+            # large fixed overhead (~10-15 ms through the tunnel), so a
+            # naive timer — and even a single chained scan divided by its
+            # length — reads overhead, not compute.  Measure a jitted
+            # lax.scan of K chained applications and one of 2K (same
+            # structure, each iteration's output feeding the next), then
+            # take the SLOPE (t_2K - t_K)/K: the fixed dispatch/readback
+            # cost cancels exactly, leaving per-application compute.
             chain = self.chain
 
             def loss_of(p, xs_):
@@ -164,46 +213,74 @@ class MeasuredCostModel:
                 return (res.astype("float32") ** 2).sum()
 
             if params:
-                def chained(p, xs_):
-                    def body(p, _):
-                        g = jax.grad(loss_of)(p, xs_)
-                        p = jax.tree.map(
-                            lambda a, b: a - 1e-6 * b.astype(a.dtype), p, g)
-                        return p, 0.0
+                def make_fn(k):
+                    def chained(p, xs_):
+                        def body(p, _):
+                            g = jax.grad(loss_of)(p, xs_)
+                            p = jax.tree.map(
+                                lambda a, b: a - 1e-6 * b.astype(a.dtype),
+                                p, g)
+                            return p, 0.0
 
-                    p, _ = jax.lax.scan(body, p, jnp.arange(chain))
-                    return jax.tree.leaves(p)[0].ravel()[0]
+                        p, _ = jax.lax.scan(body, p, jnp.arange(k))
+                        return jax.tree.leaves(p)[0].ravel()[0]
 
-                fn = jax.jit(chained)
+                    return jax.jit(chained)
+
                 args = (params, xs)
             else:
                 grad_ok = op.inputs[0].dtype != "int32"
 
-                def chained2(xs_):
-                    def body(xs_, _):
-                        if grad_ok:
-                            g = jax.grad(lambda x: loss_of({}, x))(xs_)
-                            xs_ = [a - 1e-6 * b.astype(a.dtype)
-                                   for a, b in zip(xs_, g)]
-                        else:
-                            v = loss_of({}, xs_)
-                            xs_ = [xs_[0] + (v * 0).astype(xs_[0].dtype)
-                                   ] + list(xs_[1:])
-                        return xs_, 0.0
+                def make_fn(k):
+                    def chained2(xs_):
+                        def body(xs_, _):
+                            if grad_ok:
+                                g = jax.grad(lambda x: loss_of({}, x))(xs_)
+                                xs_ = [a - 1e-6 * b.astype(a.dtype)
+                                       for a, b in zip(xs_, g)]
+                            else:
+                                v = loss_of({}, xs_)
+                                xs_ = [xs_[0] + (v * 0).astype(xs_[0].dtype)
+                                       ] + list(xs_[1:])
+                            return xs_, 0.0
 
-                    xs_, _ = jax.lax.scan(body, list(xs_),
-                                          jnp.arange(chain))
-                    return xs_[0].ravel()[0]
+                        xs_, _ = jax.lax.scan(body, list(xs_),
+                                              jnp.arange(k))
+                        return xs_[0].ravel()[0]
 
-                fn = jax.jit(chained2)
+                    return jax.jit(chained2)
+
                 args = (xs,)
-            float(fn(*args))  # compile + warm
-            best = float("inf")
-            for _ in range(self.repeats):
-                t0 = time.perf_counter()
-                float(fn(*args))  # host readback = true sync
-                best = min(best, (time.perf_counter() - t0) / chain)
-            return best
+            # Adaptive chain length: the slope signal K*cost must clear the
+            # tunnel's timing jitter (~8 ms).  The analytic roofline picks
+            # the starting K (compiles are the expensive part through the
+            # tunnel — usually one level = two compiles suffices); one x8
+            # escalation covers analytic overestimates.  Median of paired
+            # repeats (the two lengths timed back-to-back so ambient load
+            # cancels with the fixed overhead); min would bias a noisy
+            # difference low.
+            guess = max(self.fallback.op_cost(op, pc), 1e-7)
+            k0 = 1 << max(0, (int(16e-3 / guess) - 1).bit_length())
+            k0 = min(max(k0, chain), 2048)
+            est = None
+            for k in (k0, k0 * 8):
+                fn_k, fn_2k = make_fn(k), make_fn(2 * k)
+                float(fn_k(*args))   # compile + warm
+                float(fn_2k(*args))
+                slopes = []
+                for _ in range(self.repeats):
+                    t0 = time.perf_counter()
+                    float(fn_k(*args))   # host readback = true sync
+                    t_k = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    float(fn_2k(*args))
+                    t_2k = time.perf_counter() - t0
+                    slopes.append((t_2k - t_k) / k)
+                slopes.sort()
+                est = slopes[len(slopes) // 2]
+                if est * k >= 8e-3:  # signal well above tunnel jitter
+                    return est
+            return est if est and est > 0.0 else None
         except Exception as e:  # analytic fallback, but say so once per kind
             kind = type(op).__name__
             if kind not in self._warned_kinds:

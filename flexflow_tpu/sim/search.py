@@ -373,21 +373,9 @@ class StrategySearch:
 
     @staticmethod
     def _param_replicas(op: Op, pc: ParallelConfig) -> float:
-        specs = op.param_specs()
-        if not specs:
-            return 1.0
-        shard_axes = set()
-        for spec in specs.values():
-            for entry in spec:
-                if entry is None:
-                    continue
-                for a in (entry if isinstance(entry, tuple) else (entry,)):
-                    shard_axes.add(a)
-        sizes = dict(zip(op.AXIS_NAMES, pc.dims))
-        shard = 1
-        for a in shard_axes:
-            shard *= sizes.get(a, 1)
-        return pc.num_parts / shard
+        from flexflow_tpu.sim.cost_model import param_shard_fraction
+
+        return pc.num_parts * param_shard_fraction(op, pc)
 
     # ------------------------------------------------------------------
 
