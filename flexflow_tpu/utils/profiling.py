@@ -133,21 +133,39 @@ def compiled_cost(fn, *args) -> Dict[str, float]:
     }
 
 
-def step_roofline(fn, *args, seconds_per_step: Optional[float] = None,
-                  perf=None) -> Dict[str, float]:
-    """Roofline summary of a train step: modeled FLOPs/bytes plus, when a
-    measured step time is supplied, achieved TFLOP/s and HBM GB/s."""
+def compiled_roofline(compiled, seconds_per_step: Optional[float] = None,
+                      perf=None, n_devices: int = 1) -> Dict[str, float]:
+    """Roofline summary from an already-compiled executable (no extra
+    compile): post-fusion FLOPs/bytes plus, when a measured step time is
+    supplied, achieved TFLOP/s, HBM GB/s and MXU utilization.
+
+    ``cost_analysis()`` FLOPs are GLOBAL (pre-partitioning) under SPMD, so
+    pass ``n_devices`` to compare against the whole machine's peak."""
     from flexflow_tpu.sim.cost_model import TpuChipPerf
 
     perf = perf or TpuChipPerf()
-    cost = compiled_cost(fn, *args)
+    peak = perf.peak_flops * max(n_devices, 1)
+    hbm = perf.hbm_bandwidth * max(n_devices, 1)
+    ca = normalize_cost_analysis(compiled)
+    cost = {"flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
     out = dict(cost)
-    out["min_step_seconds_at_peak"] = (
-        cost["flops"] / perf.peak_flops if perf.peak_flops else 0.0)
+    out["min_step_seconds_at_peak"] = cost["flops"] / peak if peak else 0.0
     if seconds_per_step and seconds_per_step > 0:
         out["achieved_tflops"] = cost["flops"] / seconds_per_step / 1e12
         out["achieved_hbm_gbps"] = (
             cost["bytes_accessed"] / seconds_per_step / 1e9)
-        out["mxu_utilization"] = (
-            cost["flops"] / seconds_per_step / perf.peak_flops)
+        out["mxu_utilization"] = cost["flops"] / seconds_per_step / peak
+        out["hbm_utilization"] = (
+            cost["bytes_accessed"] / seconds_per_step / hbm)
     return out
+
+
+def step_roofline(fn, *args, seconds_per_step: Optional[float] = None,
+                  perf=None, n_devices: int = 1) -> Dict[str, float]:
+    """Roofline summary of a train step (compiles ``fn``); see
+    :func:`compiled_roofline`."""
+    import jax
+
+    return compiled_roofline(jax.jit(fn).lower(*args).compile(),
+                             seconds_per_step, perf, n_devices)
