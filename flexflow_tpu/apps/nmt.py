@@ -5,9 +5,11 @@ top_level_task, flags parse_input_args nmt/nmt.cc:235-267: -b batch size,
     python -m flexflow_tpu.apps.nmt -b 64 -l 2 -s 20 -h 2048 -e 2048
 
 Extras beyond the reference: --vocab, --iters, --chunk (LSTM steps per
-chunk op), --strategy <file>, --dtype, --seed.  Data is synthetic random
-token pairs (the reference initializes its word tensors with constants,
-nmt/rnn.cu:89-126).
+chunk op), --strategy <file>, --pipeline-stages S (generate the stage
+strategy: LSTM layer l on device block l%S — the reference's per-op
+placement pipelining, nmt/nmt.cc:269-308 — and wavefront-execute it),
+--dtype, --seed.  Data is synthetic random token pairs (the reference
+initializes its word tensors with constants, nmt/rnn.cu:89-126).
 """
 
 from __future__ import annotations
@@ -50,6 +52,8 @@ def parse_args(argv) -> RnnConfig:
             cfg.seed = int(val())
         elif a == "--strategy":
             strategy_file = val()
+        elif a == "--pipeline-stages":
+            cfg._pipeline_stages = int(val())
         elif a == "--params-ones":
             cfg.params_init = "ones"
         elif a == "--print-intermediates":
@@ -68,6 +72,11 @@ def main(argv=None, log=print) -> dict:
     strategies = None
     if getattr(cfg, "_strategy_file", ""):
         strategies = Strategy.load(cfg._strategy_file)
+    elif getattr(cfg, "_pipeline_stages", 0):
+        from flexflow_tpu.nmt.rnn_model import pipeline_stage_strategy
+
+        strategies = pipeline_stage_strategy(cfg, machine,
+                                             cfg._pipeline_stages)
     model = RnnModel(cfg, machine, strategies)
     log(f"NMT: {cfg.num_layers} layers, seq {cfg.seq_length} "
         f"(chunks of {cfg.lstm_per_node_length}), hidden {cfg.hidden_size}, "

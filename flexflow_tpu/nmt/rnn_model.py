@@ -83,6 +83,42 @@ def default_global_config(cfg: RnnConfig, machine: MachineModel) -> Strategy:
     return s
 
 
+def pipeline_stage_strategy(cfg: RnnConfig, machine: MachineModel,
+                            num_stages: int) -> Strategy:
+    """Pipeline-parallel strategy: LSTM layer ``l`` placed on aligned device
+    block ``l % num_stages`` (stage = device block — the reference's own
+    pipeline representation, per-op-instance device lists in
+    nmt/nmt.cc:269-308).  Chunk ops of adjacent layers on different blocks
+    form DAG antidiagonals that the placement scheduler merges into
+    concurrent shard_map groups (parallel/placement.py): layer l works on
+    chunk j while layer l+1 works on chunk j-1 — wavefront/GPipe-style
+    pipelining compiled into ONE SPMD step, from a plain strategy file.
+
+    Embeds feed stage 0 and pin to its block; the vocab projections and
+    losses stay data-parallel over the whole machine (they consume every
+    stage's output)."""
+    n = machine.num_devices
+    if num_stages < 1 or n % num_stages:
+        raise ValueError(
+            f"{num_stages} stages do not divide the {n}-device machine")
+    per = n // num_stages
+    blocks = [tuple(range(g * per, (g + 1) * per))
+              for g in range(num_stages)]
+    devs = tuple(range(n))
+    npc = cfg.chunks_per_seq
+    s = Strategy()
+    for i in range(2 * npc):
+        s[f"embed{i}"] = ParallelConfig((per,), blocks[0])
+    for l in range(cfg.num_layers):
+        blk = blocks[l % num_stages]
+        for j in range(2 * npc):
+            s[f"lstm{l}_{j}"] = ParallelConfig((per,), blk)
+    for j in range(npc):
+        s[f"linear{j}"] = ParallelConfig((1, n), devs)
+        s[f"softmax{j}"] = ParallelConfig((n,), devs)
+    return s
+
+
 class RnnModel(FFModel):
     def __init__(self, rnn_config: RnnConfig = None,
                  machine: Optional[MachineModel] = None,

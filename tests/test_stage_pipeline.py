@@ -1,0 +1,79 @@
+"""Pipeline stages expressed in the strategy format (VERDICT r1 item 7).
+
+The reference's pipeline is per-op-instance device placement in one config
+(nmt/nmt.cc:269-308) — chunk ops on distinct devices wavefront under
+Legion's task graph (nmt/rnn.cu:298-326).  Here the SAME representation
+(ParallelConfig device blocks in a strategy file) drives the placement
+scheduler: stage = aligned device block; chunk ops of different stages on
+DAG antidiagonals merge into concurrent shard_map groups.  These tests pin
+the full loop: helper -> strategy FILE (reference wire format) -> load ->
+train -> loss identical to non-pipelined."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.nmt.rnn_model import (RnnConfig, RnnModel,
+                                        default_global_config,
+                                        pipeline_stage_strategy,
+                                        synthetic_token_batches)
+from flexflow_tpu.parallel.placement import PlacementGroup
+from flexflow_tpu.strategy import Strategy
+
+
+def tiny_cfg():
+    return RnnConfig(batch_size=8, num_layers=2, seq_length=8,
+                     hidden_size=16, embed_size=16, vocab_size=64,
+                     lstm_per_node_length=4, num_iterations=1)
+
+
+def test_stage_strategy_shapes(machine8):
+    cfg = tiny_cfg()
+    s = pipeline_stage_strategy(cfg, machine8, num_stages=2)
+    # layer 0 chunks on block 0, layer 1 chunks on block 1
+    assert s["lstm0_0"].devices == (0, 1, 2, 3)
+    assert s["lstm1_0"].devices == (4, 5, 6, 7)
+    assert s["embed0"].devices == (0, 1, 2, 3)
+
+
+def test_bad_stage_count_raises(machine8):
+    with pytest.raises(ValueError):
+        pipeline_stage_strategy(tiny_cfg(), machine8, num_stages=3)
+
+
+def test_two_stage_pipeline_from_file_matches_dp(machine8, tmp_path):
+    """A 2-stage pipeline specified in a strategy FILE (saved in the
+    reference's proto wire format, reloaded like any strategy) trains with
+    a loss trajectory identical to the non-pipelined DP run, and actually
+    wavefronts (adjacent-stage chunk ops grouped for concurrent
+    execution)."""
+    cfg = tiny_cfg()
+    path = str(tmp_path / "nmt_2stage.pb")
+    pipeline_stage_strategy(cfg, machine8, num_stages=2).save(path)
+
+    loaded = Strategy.load(path)
+    assert loaded["lstm1_0"].devices == (4, 5, 6, 7)  # wire round-trip
+
+    piped = RnnModel(cfg, machine8, loaded)
+    sched = piped._placement_schedule(frozenset())
+    groups = [e for e in sched if isinstance(e, PlacementGroup)
+              and e.members[0].name.startswith("lstm")]
+    cross_stage = [
+        g for g in groups if len(g.members) == 2
+        and {m.pc.devices[0] // 4 for m in g.members} == {0, 1}
+    ]
+    assert cross_stage, "no adjacent-stage chunk pair executes concurrently"
+
+    def losses(model):
+        data = synthetic_token_batches(machine8, cfg.batch_size, 8, 64,
+                                       seed=3)
+        params, state = model.init(seed=0)
+        step = model.make_train_step()
+        out = []
+        for _ in range(3):
+            params, state, _, loss = step(params, state, None, *next(data))
+            out.append(float(loss))
+        return out
+
+    dp = RnnModel(cfg, machine8, default_global_config(cfg, machine8))
+    np.testing.assert_allclose(losses(piped), losses(dp),
+                               rtol=1e-5, atol=1e-6)
