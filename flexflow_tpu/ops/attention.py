@@ -70,6 +70,13 @@ class MultiHeadAttention(Op):
 
         return P("n", "s", None)
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        # batch over n, sequence over s, d replicated over h (the q/k/v
+        # projections are column-sharded by head)
+        return [P("n", "s", None)]
+
     def _use_ring(self) -> bool:
         s_parts = self.pc.dims[0]
         return (s_parts > 1 and self.machine is not None

@@ -59,6 +59,11 @@ class Embed(Op):
 
         return [P("n", None)]
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None)]
+
     def placement_signature(self):
         # embeds pinned to distinct devices (the reference's explicit
         # GPU-0/1 placement, nmt/nmt.cc:273-299) group when table geometry

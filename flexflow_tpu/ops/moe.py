@@ -87,6 +87,13 @@ class MixtureOfExperts(Op):
                 "w1": P("e", None, "c"), "b1": P("e", "c"),
                 "w2": P("e", "c", None), "b2": P("e", None)}
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        # tokens batch-sharded over n, replicated over (e, c); the expert
+        # all-to-all is emitted inside the op from the 'e' constraints
+        return [P("n", None, None)]
+
     def output_specs(self) -> List:
         from jax.sharding import PartitionSpec as P
 

@@ -52,6 +52,11 @@ class RnnLinear(Op):
 
         return [P("n", None, None)]
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", None, None)]
+
     def placement_signature(self):
         return (self.in_channels, self.out_channels)
 

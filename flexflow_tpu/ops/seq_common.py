@@ -12,9 +12,24 @@ from flexflow_tpu.ops.base import Op, Tensor
 from flexflow_tpu.strategy import ParallelConfig
 
 
-class LayerNormSeq(Op):
+class _SeqElementwise(Op):
+    """Shared (s, n)-grid elementwise base: output and preferred input
+    layouts are batch-over-n, sequence-over-s, features replicated."""
+
     AXIS_NAMES = ("s", "n")
 
+    def output_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P("n", "s", None)
+
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        return [P("n", "s", None)] * len(self.inputs)
+
+
+class LayerNormSeq(_SeqElementwise):
     def __init__(self, name: str, pc: ParallelConfig, input: Tensor,
                  eps: float = 1e-5):
         super().__init__(name, pc, [input])
@@ -33,11 +48,6 @@ class LayerNormSeq(Op):
         from jax.sharding import PartitionSpec as P
 
         return {"scale": P(None), "bias": P(None)}
-
-    def output_spec(self):
-        from jax.sharding import PartitionSpec as P
-
-        return P("n", "s", None)
 
     def forward(self, params, state, xs: List, train: bool):
         import jax
@@ -58,18 +68,11 @@ class LayerNormSeq(Op):
         return 8 * self.d
 
 
-class AddSeq(Op):
-    AXIS_NAMES = ("s", "n")
-
+class AddSeq(_SeqElementwise):
     def __init__(self, name: str, pc: ParallelConfig, inputs: List[Tensor]):
         super().__init__(name, pc, inputs)
         assert len(inputs) == 2 and inputs[0].shape == inputs[1].shape
         self.output = Tensor(inputs[0].shape, inputs[0].dtype, self, name)
-
-    def output_spec(self):
-        from jax.sharding import PartitionSpec as P
-
-        return P("n", "s", None)
 
     def forward(self, params, state, xs: List, train: bool):
         return xs[0] + xs[1], state
@@ -80,18 +83,11 @@ class AddSeq(Op):
         return float(math.prod(self.output.shape[1:]))
 
 
-class GeluSeq(Op):
-    AXIS_NAMES = ("s", "n")
-
+class GeluSeq(_SeqElementwise):
     def __init__(self, name: str, pc: ParallelConfig, input: Tensor):
         super().__init__(name, pc, [input])
         assert input.ndim == 3
         self.output = Tensor(input.shape, input.dtype, self, name)
-
-    def output_spec(self):
-        from jax.sharding import PartitionSpec as P
-
-        return P("n", "s", None)
 
     def forward(self, params, state, xs: List, train: bool):
         import jax
@@ -104,10 +100,8 @@ class GeluSeq(Op):
         return 8.0 * float(math.prod(self.output.shape[1:]))
 
 
-class PosEmbed(Op):
+class PosEmbed(_SeqElementwise):
     """Learned positional embedding added to the token embedding."""
-
-    AXIS_NAMES = ("s", "n")
 
     def __init__(self, name: str, pc: ParallelConfig, input: Tensor):
         super().__init__(name, pc, [input])
@@ -126,11 +120,6 @@ class PosEmbed(Op):
         from jax.sharding import PartitionSpec as P
 
         return {"table": P("s", None)}
-
-    def output_spec(self):
-        from jax.sharding import PartitionSpec as P
-
-        return P("n", "s", None)
 
     def forward(self, params, state, xs: List, train: bool):
         (x,) = xs

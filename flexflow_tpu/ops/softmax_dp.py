@@ -34,6 +34,13 @@ class SoftmaxDP(Op):
 
         return P("n", None, None)
 
+    def regrid_input_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        # the reference's explicit logit repartition to batch-only sharding
+        # (nmt/softmax_data_parallel.cu:85-100)
+        return [P("n", None, None), P("n", None)]
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
 

@@ -136,3 +136,25 @@ class TestNoInvoluntaryRemat:
         ff.compile_train_step(image, labels)
         err = capfd.readouterr().err
         assert "Involuntary full rematerialization" not in err
+
+    def test_mixed_transformer_compiles_clean(self, machine8, capfd):
+        """Per-layer CP x TP x DP mixes (incl. a combined (2,2,2) attention
+        grid) compile without remat fallbacks."""
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     TransformerLM)
+
+        devs = all8()
+        tc = TransformerConfig(batch_size=8, seq_length=32, num_layers=2,
+                               d_model=32, num_heads=4, d_ff=64,
+                               vocab_size=128, causal=True)
+        s = Strategy()
+        s["blk0_attn"] = ParallelConfig((2, 2, 2), devs)
+        s["blk1_attn"] = ParallelConfig((1, 4, 2), devs)
+        s["blk0_ff1"] = ParallelConfig((4, 2), devs)
+        s["blk1_ff1"] = ParallelConfig((2, 4), devs)
+        s["lm_head"] = ParallelConfig((8, 1), devs)
+        tlm = TransformerLM(tc, machine8, s)
+        toks = jax.ShapeDtypeStruct((8, 32), "int32")
+        tlm.compile_train_step(toks, toks)
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" not in err
