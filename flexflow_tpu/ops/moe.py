@@ -7,15 +7,22 @@ The op follows the same per-layer-strategy design as every other op: a
 strategy file can place each MoE layer independently (pure EP, EP x TP,
 EP x DP, ...).
 
-TPU-native design (GShard/Switch-style dense dispatch):
+TPU-native design (GShard/Switch semantics, index-based dispatch):
 
-  * routing builds static-shaped dispatch/combine tensors (one-hot over a
-    fixed per-expert capacity) — no dynamic shapes, so XLA tiles every
-    einsum onto the MXU;
-  * the token->expert shuffle is the ``bsec,bsd->ebcd`` dispatch einsum
-    under an ('e','n') sharding constraint: GSPMD lowers the resharding
-    from batch-sharded tokens to expert-sharded slots as an all-to-all
-    over ICI — the hand-written NCCL a2a of GPU MoE frameworks;
+  * routing computes static-shaped INDEX tensors — per expert-slot the
+    source token (``src``), per token its k (slot, weight) pairs — via
+    cumsum positions and O(B*S*k) scatters; capacity overflow drops
+    tokens exactly as GShard's dense one-hot formulation does;
+  * the token->expert shuffle is a gather from the token-sharded
+    activations into the ('e','n')-constrained slot tensor (and a gather
+    back for combine): GSPMD lowers the resharding as collectives over
+    ICI — the hand-written NCCL a2a of GPU MoE frameworks.  The classic
+    dense ``bsec,bsd->ebcd`` dispatch einsum nominally costs
+    2*B*S*E*C*D FLOPs just to move data; the gathers cost bytes only.
+    (Measured end-to-end on v5e the two are equal — XLA evidently does
+    not execute the one-hot contraction naively — but the index form
+    keeps the simulator's FLOP model honest and the intent explicit;
+    equivalence to the dense GShard spec is tested.)
   * expert FFNs run as one batched einsum over the local experts
     (weights sharded P('e', ..., 'c')), combining EP with the reference's
     channel TP (linear.cu's c-axis) inside each expert;
@@ -124,14 +131,17 @@ class MixtureOfExperts(Op):
                 y, self.machine.sharding(self.pc, self.AXIS_NAMES, spec))
         return y
 
-    def _route(self, probs):
-        """Static-shaped top-k routing -> (dispatch, combine, aux).
+    def _route_indices(self, probs):
+        """Static-shaped top-k routing as indices.
 
-        dispatch (B,S,E,C): 0/1, token (b,s) occupies slot c of expert e.
-        combine  (B,S,E,C): dispatch weighted by renormalized gate prob.
+        Returns (src, slots, weights, aux):
+          src     (B, E*C) int32 — token position filling each expert slot
+                  (sentinel S = empty slot);
+          slots   (B, S, k) int32 — flat e*C+c slot per token choice
+                  (sentinel E*C = dropped);
+          weights (B, S, k) f32 — renormalized gate weights (0 if dropped).
         Tokens beyond an expert's capacity are dropped for that expert
-        (their combine mass is lost — standard GShard semantics).
-        """
+        (their combine mass is lost — standard GShard semantics)."""
         import jax
         import jax.numpy as jnp
 
@@ -144,23 +154,50 @@ class MixtureOfExperts(Op):
         # a renormalized weight would be the constant 1.0 and sever the
         # router's gradient from the task loss)
         counts = jnp.zeros((b, e), "float32")
-        dispatch = jnp.zeros((b, s, e, c), "float32")
-        combine = jnp.zeros((b, s, e, c), "float32")
+        slot_l, w_l = [], []
         for i in range(k):                                   # k is tiny/static
-            oh = jax.nn.one_hot(top_i[:, :, i], e, dtype="float32")
-            # slot index: tokens before me routed here (this slot pass) +
+            e_i = top_i[:, :, i]                             # (B,S)
+            oh = jax.nn.one_hot(e_i, e, dtype="float32")
+            # slot index: tokens before me routed here (this pass) +
             # tokens already placed by higher-priority passes
             pos = jnp.cumsum(oh, axis=1) - oh + counts[:, None, :]
-            keep = oh * (pos < c)
-            counts = counts + keep.sum(axis=1)
-            slot = keep[..., None] * jax.nn.one_hot(
-                pos.astype("int32"), c, dtype="float32")
-            dispatch = dispatch + slot
-            combine = combine + top_p[:, :, i][..., None, None] * slot
+            counts = counts + (oh * (pos < c)).sum(axis=1)
+            p_i = jnp.take_along_axis(pos, e_i[..., None], -1)[..., 0]
+            keep = p_i < c
+            slot_l.append(jnp.where(
+                keep, e_i * c + p_i.astype("int32"), e * c).astype("int32"))
+            w_l.append(jnp.where(keep, top_p[:, :, i], 0.0))
+        slots = jnp.stack(slot_l, -1)                        # (B,S,k)
+        weights = jnp.stack(w_l, -1)                         # (B,S,k)
+        # invert: token position per slot (unique by construction — pos is
+        # a running count offset by previous passes' placements)
+        src = jnp.full((b, e * c + 1), s, "int32")
+        bidx = jnp.arange(b)[:, None, None]
+        sgrid = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :, None],
+                                 slots.shape)
+        src = src.at[bidx, slots].set(sgrid)[:, :e * c]
         # Switch aux loss: E * sum_e f_e * P_e, f from top-1 assignments
         f = jax.nn.one_hot(top_i[:, :, 0], e, dtype="float32").mean((0, 1))
         aux = e * jnp.sum(f * probs.mean((0, 1)))
-        return dispatch, combine, aux
+        return src, slots, weights, aux
+
+    def _route(self, probs):
+        """Dense (dispatch, combine, aux) reconstructed from the index
+        routing — the classic GShard one-hot form, kept as the executable
+        specification the index path is tested against."""
+        import jax.numpy as jnp
+
+        b, s, e = probs.shape
+        c = self.capacity
+        src, slots, weights, aux = self._route_indices(probs)
+        bidx = jnp.arange(b)[:, None, None]
+        sidx = jnp.broadcast_to(jnp.arange(s)[None, :, None], slots.shape)
+        disp = jnp.zeros((b, s, e * c + 1), "float32"
+                         ).at[bidx, sidx, slots].add(1.0)
+        comb = jnp.zeros((b, s, e * c + 1), "float32"
+                         ).at[bidx, sidx, slots].add(weights)
+        return (disp[..., :e * c].reshape(b, s, e, c),
+                comb[..., :e * c].reshape(b, s, e, c), aux)
 
     def forward(self, params, state, xs: List, train: bool):
         import jax
@@ -168,14 +205,19 @@ class MixtureOfExperts(Op):
         from jax.sharding import PartitionSpec as P
 
         (x,) = xs
+        b, s, d = x.shape
+        e, c = self.num_experts, self.capacity
         # routing in float32 (router numerics are precision-sensitive)
         logits = jnp.einsum("bsd,de->bse", x.astype("float32"), params["wg"])
-        dispatch, combine, aux = self._route(
+        src, slots, weights, aux = self._route_indices(
             jax.nn.softmax(logits, axis=-1))
-        # token -> expert-slot shuffle; the 'e'-sharding constraint makes
-        # GSPMD emit the all-to-all over ICI
-        xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(x.dtype), x,
-                         preferred_element_type=jnp.float32).astype(x.dtype)
+        # token -> expert-slot shuffle: a gather (the sentinel indexes the
+        # padded zero row); the 'e'-sharding constraint makes GSPMD emit
+        # the collective over ICI.  The routing weight multiplies at
+        # combine only, so the gather moves raw activations (GShard).
+        xpad = jnp.concatenate([x, jnp.zeros((b, 1, d), x.dtype)], axis=1)
+        xin = xpad[jnp.arange(b)[:, None], src]              # (B,E*C,D)
+        xin = xin.reshape(b, e, c, d).transpose(1, 0, 2, 3)  # (E,B,C,D)
         xin = self._constrain(xin, P("e", "n", None, None))
         h = jnp.einsum("ebcd,edf->ebcf", xin, params["w1"].astype(x.dtype),
                        preferred_element_type=jnp.float32)
@@ -185,9 +227,12 @@ class MixtureOfExperts(Op):
                         preferred_element_type=jnp.float32)
         yo = (yo + params["b2"][:, None, None, :]).astype(x.dtype)
         yo = self._constrain(yo, P("e", "n", None, None))
-        # expert-slot -> token combine (the reverse all-to-all)
-        y = jnp.einsum("bsec,ebcd->bsd", combine, yo.astype("float32"),
-                       preferred_element_type=jnp.float32)
+        # expert-slot -> token combine: gather each token's k slot outputs
+        # back and mix with the gate weights (the reverse collective)
+        yo_f = yo.transpose(1, 0, 2, 3).reshape(b, e * c, d)
+        yo_pad = jnp.concatenate([yo_f, jnp.zeros((b, 1, d), yo_f.dtype)], 1)
+        yg = yo_pad[jnp.arange(b)[:, None, None], slots]     # (B,S,k,D)
+        y = (weights[..., None] * yg.astype("float32")).sum(2)
         return (y.astype(x.dtype), aux), state
 
     # ---- cost model ----------------------------------------------------
@@ -205,23 +250,23 @@ class MixtureOfExperts(Op):
     def flops_per_sample(self) -> float:
         s, d, f = self.output.shape[1], self.d_model, self.d_ff
         e, c = self.num_experts, self.capacity
-        # router + dispatch/combine einsums + expert FFNs over E*C slots
-        return (2.0 * s * d * e + 4.0 * s * e * c * d
+        # router + combine mix + expert FFNs over E*C slots (the
+        # dispatch/combine shuffles are index gathers — bytes, not FLOPs)
+        return (2.0 * s * d * e + 2.0 * s * self.top_k * d
                 + 4.0 * e * c * d * f)
 
     def shard_flops_fwd(self, pc: ParallelConfig):
-        # The three terms shard over different axes: the router is
-        # replicated over (e, c); dispatch/combine shard over (e, n) only;
-        # the expert FFNs shard over all of (e, c, n).  A uniform
-        # flops/num_parts split would under-cost EP x TP grids.
+        # The terms shard over different axes: the router/combine mix are
+        # replicated over (e, c); the expert FFNs shard over all of
+        # (e, c, n).  A uniform flops/num_parts split would under-cost
+        # EP x TP grids.
         pe, pcc, pn = pc.dims
         b, s, d = self.inputs[0].shape
         f, e, c = self.d_ff, self.num_experts, self.capacity
         local_b = b / pn
-        router = 2.0 * s * d * e * local_b
-        shuffle = 4.0 * s * e * c * d * local_b / pe
+        router = (2.0 * s * d * e + 2.0 * s * self.top_k * d) * local_b
         ffn = 4.0 * e * c * d * f * local_b / (pe * pcc)
-        return router + shuffle + ffn
+        return router + ffn
 
     def cost_signature(self) -> tuple:
         # expert work is invisible in the (B,S,D) input/output shapes
