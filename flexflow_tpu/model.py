@@ -26,7 +26,7 @@ over the *global* batch (see ops/softmax.py for why this normalization).
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.machine import MachineModel

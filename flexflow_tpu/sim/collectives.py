@@ -24,8 +24,6 @@ Conventions:
 
 from __future__ import annotations
 
-import math
-
 from flexflow_tpu.machine import Topology
 from flexflow_tpu.ops.base import Op
 from flexflow_tpu.strategy import ParallelConfig

@@ -16,11 +16,9 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import numpy as np
-
 from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.model import FFModel
-from flexflow_tpu.ops.base import Op, Tensor
+from flexflow_tpu.ops.base import Op
 from flexflow_tpu.sim.collectives import collective_cost
 from flexflow_tpu.sim.cost_model import AnalyticCostModel
 from flexflow_tpu.sim.native import NativeSimulator

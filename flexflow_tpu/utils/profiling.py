@@ -27,8 +27,6 @@ import contextlib
 import dataclasses
 from typing import Dict, List, Optional
 
-from flexflow_tpu.ops.base import Op
-
 
 @contextlib.contextmanager
 def trace(logdir: str):
