@@ -2,9 +2,19 @@
 
 Replaces the reference's mapper layer (cnn_mapper.cc, nmt/rnn_mapper.cc) and
 its hard-coded cluster constants (scripts/simulator.cc:32-38).  Placement on
-TPU is expressed by building a ``jax.sharding.Mesh`` from each op's
-``ParallelConfig.devices`` grid; XLA/GSPMD then emits collectives over
+TPU is expressed as shardings; XLA/GSPMD then emits collectives over
 ICI/DCN — there is no imperative mapper.
+
+Round-2 design: the machine is prime-factored ONCE into the
+:meth:`MachineModel.global_mesh` axes, and every decomposable
+ParallelConfig is translated to a PartitionSpec on that one mesh
+(:meth:`global_assign` / :meth:`global_entries`) — provably the same
+shard→device map as the per-op meshes of :meth:`mesh_for`
+(tests/test_regrid.py).  Sharing one mesh lets producer→consumer grid
+changes decompose into single-axis hops (:meth:`regrid_steps`) that GSPMD
+lowers as all-to-all / all-gather / slice instead of its involuntary
+full-rematerialization fallback.  Per-op meshes remain for shard_map
+consumers (ring attention, the fused LM head, placement groups).
 """
 
 from __future__ import annotations

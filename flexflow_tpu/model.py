@@ -13,9 +13,11 @@ Reference behavior mapped here:
     TPU-native analog of Legion's asynchronous task graph for an iteration
     (SURVEY.md §3.1 "the hot loop");
   * per-op partitioning is applied as ``with_sharding_constraint`` on each
-    op's output (and on its params at init), so GSPMD derives all
-    repartitioning between differently-gridded producers/consumers — the
-    role of Legion's implicit copies (conv_2d.cu:171-208);
+    op's output (and on its params at init) over the ONE global factored
+    mesh, and repartitioning between differently-gridded
+    producers/consumers — the role of Legion's implicit copies
+    (conv_2d.cu:171-208) — is decomposed by ``_regrid_inputs`` into
+    single-mesh-axis hops GSPMD lowers without full rematerialization;
   * ``update()``'s replica aggregation (updateGAS, cuda_helper.cu:57-71) is
     implicit: gradients of replicated params arrive all-reduced by GSPMD.
 
