@@ -428,9 +428,27 @@ class FFModel:
         return self.machine.honored_placements(
             getattr(self, "_honored_pcs", ()))
 
+    def _plan(self, train: bool):
+        """(fusion plan, schedule) for one apply — the ONE gating shared by
+        apply() and _apply(), so the pre-planned honored set always matches
+        the schedule actually executed (both underlying planners cache)."""
+        dump = self.config.print_intermediates
+        fusion = self._lm_head_fusion() if (train and not dump) else {}
+        if self.machine.num_devices > 1 and not dump:
+            schedule = self._placement_schedule(frozenset(fusion))
+        else:
+            schedule = range(len(self.layers))
+        return fusion, schedule
+
     def apply(self, params, state, inputs: Dict[int, Any], train: bool):
         """Run the DAG. ``inputs`` maps input-Tensor tid -> array.
         Returns (tensor-values dict, new_state)."""
+        # Plan the schedule _apply will use BEFORE snapshotting the honored
+        # set, so a placement group that exists only under this fusion
+        # exclusion is already marked honored when tracing starts (round-2
+        # ADVICE: the late plan drew a spurious one-time "placement not
+        # honored" warning from run_group's output sharding constraint).
+        self._plan(train)
         with self._honored_ctx():
             return self._apply(params, state, inputs, train)
 
@@ -442,11 +460,7 @@ class FFModel:
 
         multi = self.machine.num_devices > 1
         dump = self.config.print_intermediates
-        fusion = self._lm_head_fusion() if (train and not dump) else {}
-        if multi and not dump:
-            schedule = self._placement_schedule(frozenset(fusion))
-        else:
-            schedule = range(len(self.layers))
+        fusion, schedule = self._plan(train)
         values: Dict[int, Any] = dict(inputs)
         new_state: Dict[str, Dict] = {}
         # tid -> global-mesh entry tuple of each produced value, for

