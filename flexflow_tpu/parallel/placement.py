@@ -28,10 +28,18 @@ here:
 
 Supported placements: each op's ``devices`` must be one aligned contiguous
 block ``[g*P, (g+1)*P)`` of the machine (P = the op's grid size).  Ops are
-groupable when they share shapes/hyperparameters (``Op.placement_signature``)
-and declare their input partitioning (``Op.input_specs``).  Anything else
-degrades to the replicated normalization in ``MachineModel.sharding`` with
-a warning.
+groupable when they declare their input partitioning (``Op.input_specs``)
+and either share shapes/hyperparameters (``Op.placement_signature`` — the
+homogeneous fast path, params stacked with their inner sharding kept) or
+are merely *grid-compatible* (same grid dims/axes, block-replicated
+params, agreeing output positions — the HETEROGENEOUS path, round-3:
+different op kinds run as different branches of one switch, params
+flattened to a padded f32 vector stacked over the group axis, outputs
+padded to a per-position union aval).  That restores the reference's
+Legion-style concurrency between *different* ops on disjoint device sets
+(embeds on one block while LSTMs run on another, nmt/rnn.cu:298-326,
+nmt/rnn_mapper.cc:28-41).  Anything else degrades to the replicated
+normalization in ``MachineModel.sharding`` with a warning.
 """
 
 from __future__ import annotations
@@ -79,6 +87,66 @@ def _signature(op: Op) -> tuple:
             op.placement_signature())
 
 
+def _params_block_replicated(op: Op) -> bool:
+    """True when ``op``'s params are replicated *within* its placement
+    block under its grid (every spec axis has grid size 1) — the
+    heterogeneous path carries params as one flat vector per block and
+    cannot preserve inner param sharding."""
+    specs = op.param_specs()
+    if not specs:
+        return True
+    sizes = dict(zip(op.AXIS_NAMES, op.pc.dims))
+    for spec in specs.values():
+        for entry in spec:
+            if entry is None:
+                continue
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                if sizes.get(a, 1) != 1:
+                    return False
+    return True
+
+
+def _out_positions(op: Op):
+    """Per output position: (spec entries, rank, sharded-dim extents,
+    dtype) — the compatibility record heterogeneous grouping checks so
+    every member's position-k output can share one switch aval and one
+    out_spec."""
+    sizes = dict(zip(op.AXIS_NAMES, op.pc.dims))
+    out = []
+    for t, spec in zip(op.all_outputs(), op.output_specs()):
+        entries = tuple(spec) if spec is not None else None
+        sharded = []
+        if entries is not None:
+            for d, e in enumerate(entries):
+                if e is None:
+                    continue
+                names = e if isinstance(e, tuple) else (e,)
+                if any(sizes.get(a, 1) > 1 for a in names):
+                    sharded.append((d, t.shape[d]))
+        out.append((entries, t.ndim, tuple(sharded), t.dtype))
+    return tuple(out)
+
+
+def _hetero_eligible(op: Op) -> bool:
+    """Can ``op`` join a heterogeneous (mixed-kind) placement group?"""
+    if not _params_block_replicated(op):
+        return False
+    if op.output_specs() is None or any(s is None
+                                        for s in op.output_specs()):
+        return False
+    return all(t.dtype != "int32" for t in op.all_outputs())
+
+
+def _hetero_compatible(a, b) -> bool:
+    """Output-position compatibility of two _out_positions records: shared
+    positions must agree on spec, rank and sharded-dim extents (unsharded
+    dims are zero-padded to the union; sharded dims cannot be)."""
+    for pa, pb in zip(a, b):
+        if pa[:3] != pb[:3]:
+            return False
+    return True
+
+
 def plan_schedule(layers: Sequence[Op], num_devices: int,
                   exclude: frozenset = frozenset()):
     """Dataflow schedule for ``layers``: a list whose entries are either a
@@ -107,9 +175,24 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         anc.append(a)
 
     # ---- grouping ----
+    # Same-signature joins first (the homogeneous fast path keeps inner
+    # param sharding); a leftover op may then join a *grid-compatible*
+    # group heterogeneously — mixed op kinds as different switch branches
+    # (Legion concurrency between different ops, nmt/rnn.cu:298-326).
     groups: List[dict] = []
     open_by_sig: Dict[tuple, List[dict]] = {}
+    open_by_grid: Dict[tuple, List[dict]] = {}
     group_of: Dict[int, int] = {}
+
+    def join(grp, i, g, elig, pos):
+        grp["indices"].append(i)
+        grp["slots"].append(g)
+        grp["hetero_ok"] = grp["hetero_ok"] and elig
+        if pos is not None and grp["pos"] is not None \
+                and len(pos) > len(grp["pos"]):
+            grp["pos"] = pos
+        group_of[i] = grp["id"]
+
     for i, op in enumerate(layers):
         if i in exclude:
             continue
@@ -117,20 +200,37 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         if g is None:
             continue
         sig = _signature(op)
+        elig = _hetero_eligible(op)
+        pos = _out_positions(op) if elig else None
+        placed = False
         for grp in open_by_sig.get(sig, []):
             if g in grp["slots"]:
                 continue
             if any(m in anc[i] for m in grp["indices"]):
                 continue  # dependency path member -> op
-            grp["indices"].append(i)
-            grp["slots"].append(g)
-            group_of[i] = grp["id"]
+            join(grp, i, g, elig, pos)
+            placed = True
             break
-        else:
+        if not placed and elig:
+            for grp in open_by_grid.get((op.pc.dims, op.AXIS_NAMES), []):
+                if not grp["hetero_ok"] or g in grp["slots"]:
+                    continue
+                if any(m in anc[i] for m in grp["indices"]):
+                    continue
+                if not _hetero_compatible(grp["pos"], pos):
+                    continue
+                join(grp, i, g, elig, pos)
+                placed = True
+                break
+        if not placed:
             grp = {"id": len(groups), "indices": [i], "slots": [g],
-                   "subset": op.pc.num_parts}
+                   "subset": op.pc.num_parts, "hetero_ok": elig,
+                   "pos": pos}
             groups.append(grp)
             open_by_sig.setdefault(sig, []).append(grp)
+            if elig:
+                open_by_grid.setdefault(
+                    (op.pc.dims, op.AXIS_NAMES), []).append(grp)
             group_of[i] = grp["id"]
 
     # ---- merge into schedule nodes + topological order ----
@@ -207,7 +307,8 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         groups[split]["slots"].pop()
         grp = {"id": len(groups), "indices": [last],
                "slots": [placement_slot(layers[last], num_devices)],
-               "subset": layers[last].pc.num_parts}
+               "subset": layers[last].pc.num_parts,
+               "hetero_ok": False, "pos": None}
         groups.append(grp)
         group_of[last] = grp["id"]
 
@@ -218,6 +319,19 @@ def run_group(machine, group: PlacementGroup,
     """Execute a placement group jointly.  Returns, per member, the tuple
     of its output arrays (each sliced from the group-stacked result, so it
     physically lives on that member's device block)."""
+    if len({_signature(op) for op in group.members}) > 1:
+        return _run_group_hetero(machine, group, params_by_member,
+                                 inputs_by_member, train)
+    return _run_group_homogeneous(machine, group, params_by_member,
+                                  inputs_by_member, train)
+
+
+def _run_group_homogeneous(machine, group: PlacementGroup,
+                           params_by_member: List[Dict],
+                           inputs_by_member: List[List], train: bool):
+    """Same-signature members: params stacked leaf-wise over the group
+    axis with their inner sharding preserved; every branch shares one
+    output aval."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -288,6 +402,171 @@ def run_group(machine, group: PlacementGroup,
         vals = []
         for r, spec in zip(res, op0.output_specs()):
             v = r[g]
+            if spec is not None:
+                v = lax.with_sharding_constraint(
+                    v, machine.sharding(m.pc, m.AXIS_NAMES, spec))
+            vals.append(v)
+        out.append(tuple(vals))
+    return out
+
+
+def _run_group_hetero(machine, group: PlacementGroup,
+                      params_by_member: List[Dict],
+                      inputs_by_member: List[List], train: bool):
+    """Mixed-kind members (round-3): each member is its own switch branch.
+
+    lax.switch requires every branch to return identical avals, and the
+    members' param trees don't mirror, so:
+
+      * params: each member's tree is flattened, raveled to ONE f32
+        vector, zero-padded to the group max and stacked over the group
+        axis — sharded ``P("_pg")``, so weights still physically live only
+        on the block that computes with them (the branch unflattens its
+        slice back to shapes/dtypes).  Grouping admits only members whose
+        params are replicated within their block
+        (:func:`_params_block_replicated`), so no inner sharding is lost.
+      * inputs: per-member ``input_specs`` (counts and ranks may differ) —
+        the flat argument list concatenates every member's inputs.
+      * outputs: padded to the per-position union aval (grouping
+        guaranteed shared positions agree on spec/rank/sharded extents —
+        only unsharded dims pad); missing positions are zeros.  The caller
+        crops each member's outputs back to its true shapes/dtypes.
+
+    This is the reference's operator parallelism: different Legion tasks
+    on disjoint GPU sets executing concurrently (nmt/rnn.cu:298-326),
+    compiled into one SPMD computation.
+    """
+    import math as _math
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from flexflow_tpu.parallel.ring_attention import unchecked_shard_map
+
+    ops = group.members
+    op0 = ops[0]
+    G = group.n_groups
+    mesh = machine.placement_mesh(op0.pc.dims, op0.AXIS_NAMES)
+    slots = group.slots
+
+    # ---- params: flatten -> f32 ravel -> pad -> stack over _pg ----
+    metas = []   # per member: (treedef, [(shape, dtype)])
+    vecs = []
+    for m, p in zip(ops, params_by_member):
+        leaves, treedef = jax.tree.flatten(p)
+        for l in leaves:
+            # the vector rides through f32: exact for f32/bf16/f16 leaves,
+            # lossy for anything else — fail loudly rather than corrupt
+            if str(l.dtype) not in ("float32", "bfloat16", "float16"):
+                raise TypeError(
+                    f"heterogeneous placement of {m.name!r}: param dtype "
+                    f"{l.dtype} does not round-trip through the f32 "
+                    f"group vector")
+        metas.append((treedef,
+                      [(l.shape, str(l.dtype)) for l in leaves]))
+        vecs.append(
+            jnp.concatenate([l.ravel().astype(jnp.float32)
+                             for l in leaves])
+            if leaves else jnp.zeros((0,), jnp.float32))
+    lmax = max((v.shape[0] for v in vecs), default=0)
+    by_slot = {g: jnp.pad(v, (0, lmax - v.shape[0]))
+               for g, v in zip(slots, vecs)}
+    zero_vec = jnp.zeros((lmax,), jnp.float32)
+    stacked = jnp.stack([by_slot.get(g, zero_vec) for g in range(G)])
+
+    member_in_specs = [m.input_specs() for m in ops]
+    in_specs = (P("_pg", None),) + tuple(s for specs in member_in_specs
+                                         for s in specs)
+    flat_inputs = [x for xs in inputs_by_member for x in xs]
+    # the members' REAL global output avals (declared Tensor dtypes can be
+    # stale under compute-dtype propagation): crop/cast targets
+    real_avals = []
+    for m in range(len(ops)):
+        def fwd(m=m):
+            res, _ = ops[m].forward(params_by_member[m], {},
+                                    inputs_by_member[m], train)
+            return res if isinstance(res, tuple) else (res,)
+        real_avals.append(jax.eval_shape(fwd))
+    offs = [0]
+    for specs in member_in_specs:
+        offs.append(offs[-1] + len(specs))
+
+    # out_specs from the first member carrying each position
+    pos_spec = {}
+    for m in ops:
+        for k, spec in enumerate(m.output_specs()):
+            pos_spec.setdefault(k, spec)
+    n_pos = len(pos_spec)
+
+    def body(sp, *flat):
+        local_vec = sp[0]
+        gidx = lax.axis_index("_pg")
+
+        def raw_branch(m):
+            def br(_):
+                treedef, leaf_meta = metas[m]
+                leaves = []
+                off = 0
+                for shape, dtype in leaf_meta:
+                    size = int(_math.prod(shape))
+                    leaves.append(local_vec[off:off + size]
+                                  .reshape(shape).astype(dtype))
+                    off += size
+                p = jax.tree.unflatten(treedef, leaves)
+                res, _st = ops[m].forward(
+                    p, {}, list(flat[offs[m]:offs[m + 1]]), train)
+                return res if isinstance(res, tuple) else (res,)
+            return br
+
+        shapes_by_m = [jax.eval_shape(raw_branch(m), 0)
+                       for m in range(len(ops))]
+        union = []
+        for k in range(n_pos):
+            cands = [s[k] for s in shapes_by_m if len(s) > k]
+            shape = tuple(max(c.shape[d] for c in cands)
+                          for d in range(cands[0].ndim))
+            union.append((shape, jnp.result_type(*[c.dtype
+                                                   for c in cands])))
+
+        def padded_branch(m):
+            def br(_):
+                outs = raw_branch(m)(0)
+                padded = []
+                for k, (shape, dtype) in enumerate(union):
+                    if k < len(outs):
+                        o = outs[k].astype(dtype)
+                        o = jnp.pad(o, [(0, shape[d] - o.shape[d])
+                                        for d in range(o.ndim)])
+                    else:
+                        o = jnp.zeros(shape, dtype)
+                    padded.append(jnp.expand_dims(o, 0))
+                return tuple(padded)
+            return br
+
+        owned = {g: padded_branch(m) for m, g in enumerate(slots)}
+
+        def zero_branch(_):
+            return tuple(jnp.zeros((1,) + s, d) for s, d in union)
+
+        return lax.switch(gidx, [owned.get(g, zero_branch)
+                                 for g in range(G)], 0)
+
+    out_specs = tuple(P("_pg", *pos_spec[k]) for k in range(n_pos))
+    res = unchecked_shard_map(body, mesh, in_specs, out_specs)(
+        stacked, *flat_inputs)
+    # crop each member's outputs back to its true global shapes/dtypes,
+    # with the same anti-remat sharding waypoint as the homogeneous path
+    out = []
+    for i, (g, m) in enumerate(zip(slots, ops)):
+        vals = []
+        for k, spec in enumerate(m.output_specs()):
+            av = real_avals[i][k]
+            v = res[k][g]
+            if v.shape != av.shape:
+                v = lax.slice(v, (0,) * av.ndim, av.shape)
+            v = v.astype(av.dtype)
             if spec is not None:
                 v = lax.with_sharding_constraint(
                     v, machine.sharding(m.pc, m.AXIS_NAMES, spec))
