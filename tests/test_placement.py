@@ -28,18 +28,22 @@ def _linear(name, pc, n=8, d=16, c=32):
 
 def test_placement_slot_accepts_aligned_blocks():
     op = _linear("a", ParallelConfig((1, 4), (4, 5, 6, 7)))
-    assert placement_slot(op, 8) == 1
+    assert placement_slot(op, 8) == ("block", 1)
     op = _linear("b", ParallelConfig((1, 1), (3,)))
-    assert placement_slot(op, 8) == 3
+    assert placement_slot(op, 8) == ("block", 3)
 
 
 def test_placement_slot_rejects_non_blocks():
     # full machine: not a subset placement
     assert placement_slot(
         _linear("a", ParallelConfig((1, 8), tuple(range(8)))), 8) is None
-    # strided devices: not an aligned block
+    # strided constant-stride set: the stride family (round 3)
     assert placement_slot(
-        _linear("b", ParallelConfig((1, 4), (0, 2, 4, 6))), 8) is None
+        _linear("b", ParallelConfig((1, 4), (0, 2, 4, 6))), 8) \
+        == ("stride", 0)
+    # irregular list: neither family
+    assert placement_slot(
+        _linear("b2", ParallelConfig((1, 4), (0, 2, 4, 7))), 8) is None
     # misaligned block
     assert placement_slot(
         _linear("c", ParallelConfig((1, 4), (2, 3, 4, 5))), 8) is None
