@@ -532,14 +532,18 @@ class FFModel:
                     dp, ("n",), P("n"), rank=t.ndim)
         for entry in schedule:
             if isinstance(entry, PlacementGroup):
-                outs_by_member = run_group(
+                outs_by_member, states_by_member = run_group(
                     self.machine, entry,
                     [params.get(m.param_key, {}) for m in entry.members],
                     [[values[t.tid] for t in m.inputs]
-                     for m in entry.members], train)
-                for m, outs in zip(entry.members, outs_by_member):
+                     for m in entry.members], train,
+                    [state.get(m.name, {}) for m in entry.members])
+                for m, outs, st in zip(entry.members, outs_by_member,
+                                       states_by_member):
                     for t, y in zip(m.all_outputs(), outs):
                         values[t.tid] = y
+                    if st:
+                        new_state[m.name] = st
                 continue
             i = entry
             op = self.layers[i]

@@ -131,6 +131,32 @@ class Op:
         match.  None -> op does not support placed execution."""
         return None
 
+    def placed_prelude(self, xs: List, train: bool):
+        """The COLLECTIVE part of placed execution, run OUTSIDE the
+        placement group's branch switch (collectives inside lax.switch
+        branches are illegal SPMD — non-owning device blocks would never
+        reach them; member inputs are replicated over the group axis, so
+        the prelude is uniform across blocks and therefore legal).
+        Returns an aux value handed to :meth:`sharded_forward`.  Default:
+        nothing to exchange."""
+        return None
+
+    def sharded_forward(self, params, state, xs: List, train: bool,
+                        aux=None):
+        """Forward as executed INSIDE a placement-group shard_map branch,
+        where the op's grid axes (AXIS_NAMES with pc.dims > 1) are live
+        mesh axes.  MUST be collective-free (see placed_prelude — Conv2D's
+        halo exchange and BatchNorm's cross-shard statistics live there).
+        Default: the plain forward."""
+        return self.forward(params, state, xs, train)
+
+    def state_specs(self):
+        """PartitionSpec per state leaf for PLACED execution (state
+        stacked over the placement-group axis like params).  None -> a
+        stateful op cannot execute placed (the round-2 exclusion);
+        stateless ops return {}."""
+        return None if self.init_state() else {}
+
     def regrid_input_specs(self):
         """PartitionSpec per input (over AXIS_NAMES, under ``self.pc``)
         that this op's compute wants its inputs in — used by FFModel.apply
@@ -146,7 +172,10 @@ class Op:
     def validate_partitioning(self):
         """Grid dims must divide the tensor dims they partition — the
         equivalent of the reference's disjoint/complete partition asserts
-        (conv_2d.cu:108-109)."""
+        (conv_2d.cu:108-109).  Spatial (h, w) dims may split UNEVENLY
+        (parts <= extent): XLA pads the short shard, mirroring the
+        reference's restriction transform (conv_2d.cu:95-113) — this is
+        what admits 2-way splits of Inception's 35/17 extents."""
         sizes = dict(zip(self.AXIS_NAMES, self.pc.dims))
         for t, spec in zip(self.all_outputs(), self.output_specs()):
             if spec is None:
@@ -158,11 +187,17 @@ class Op:
                 parts = 1
                 for a in axes:
                     parts *= sizes.get(a, 1)
-                if t.shape[d] % parts:
-                    raise ValueError(
-                        f"op {self.name!r}: output dim {d} of size "
-                        f"{t.shape[d]} not divisible by its partition "
-                        f"count {parts} (grid {self.pc.dims})")
+                if t.shape[d] % parts == 0:
+                    continue
+                from flexflow_tpu.strategy import uneven_spatial_ok
+
+                if all(a in ("h", "w") for a in axes) \
+                        and uneven_spatial_ok(t.shape[d], parts):
+                    continue  # uneven spatial split, padded by XLA
+                raise ValueError(
+                    f"op {self.name!r}: output dim {d} of size "
+                    f"{t.shape[d]} not divisible by its partition "
+                    f"count {parts} (grid {self.pc.dims})")
 
     def param_shardings(self, machine) -> Dict:
         """Shardings for placing params as jit inputs (canonical device

@@ -46,16 +46,103 @@ class Conv2D(Op):
         self.output = Tensor((n, out_h, out_w, out_channels),
                              input.dtype, self, name)
 
+    def _spatial_placeable(self, pc) -> bool:
+        """Can this conv run under a manual (shard_map) spatial grid?
+        Supported: SAME-padded stride-1 convs (odd kernel, p = (k-1)/2) —
+        the halo exchange then reduces to 'borrow (k-1)/2 edge rows from
+        each neighbor, zeros at the boundary', exactly the conv's own zero
+        padding (sharded_forward).  Everything else keeps the batch-only
+        placed form or the canonical GSPMD path (XLA's own halo
+        machinery)."""
+        pw, ph, pcc, pn = pc.dims
+        if pcc != 1:
+            return False
+        n, h, w, _ = self.inputs[0].shape
+        for parts, extent, k, s, p in (
+                (ph, h, self.kernel_h, self.stride_h, self.padding_h),
+                (pw, w, self.kernel_w, self.stride_w, self.padding_w)):
+            if parts == 1:
+                continue
+            if s != 1 or k % 2 == 0 or p != (k - 1) // 2:
+                return False
+            if extent % parts:
+                return False
+            if (k - 1) // 2 > extent // parts:
+                return False  # halo radius exceeds the local shard — the
+                # single-hop ppermute exchange can't reach past neighbors
+        return self.output.shape[0] % pc.dims[3] == 0
+
     def input_specs(self, pc=None):
         from jax.sharding import PartitionSpec as P
 
         pc = pc or self.pc
-        # placed execution (shard_map on a device block) supports batch-only
-        # inner grids; spatial/channel splits would need explicit halo
-        # exchange inside the manual region
-        if pc.dims[:3] != (1, 1, 1):
+        # placed execution (shard_map on a device block): batch-only
+        # grids always; spatial grids for the SAME/stride-1 family via the
+        # manual halo exchange in sharded_forward
+        if pc.dims[:3] == (1, 1, 1):
+            return [P("n", None, None, None)]
+        if self._spatial_placeable(pc):
+            return [P("n", "h", "w", None)]
+        return None
+
+    def placed_prelude(self, xs: List, train: bool):
+        """Spatial halo exchange for placed grids: borrow the (k-1)/2 edge
+        rows/cols from each neighbor via ppermute — boundary shards
+        receive ppermute's zeros, which ARE the conv's zero padding.  Runs
+        outside the group switch (collectives are illegal inside); the
+        reference exchanges the same halos through Legion's restriction
+        partitions (conv_2d.cu:93-113)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        pw, ph, _pc, _pn = self.pc.dims
+        if ph == 1 and pw == 1:
             return None
-        return [P("n", None, None, None)]
+        (x,) = xs
+
+        def halo(x, axis_name, parts, k, dim):
+            r = (k - 1) // 2
+            if r == 0 or parts == 1:
+                return x
+            fwd = [(i, i + 1) for i in range(parts - 1)]
+            bwd = [(i + 1, i) for i in range(parts - 1)]
+            lo = lax.ppermute(
+                lax.slice_in_dim(x, x.shape[dim] - r, x.shape[dim],
+                                 axis=dim),
+                axis_name, fwd)
+            hi = lax.ppermute(lax.slice_in_dim(x, 0, r, axis=dim),
+                              axis_name, bwd)
+            return jnp.concatenate([lo, x, hi], axis=dim)
+
+        x = halo(x, "h", ph, self.kernel_h, 1)
+        x = halo(x, "w", pw, self.kernel_w, 2)
+        return x
+
+    def sharded_forward(self, params, state, xs: List, train: bool,
+                        aux=None):
+        """Placed-grid forward: consume the pre-haloed input from
+        placed_prelude and convolve VALID on the sharded axes (their zero
+        padding arrived with the halo)."""
+        import jax
+        from jax import lax
+
+        if aux is None:
+            return self.forward(params, state, xs, train)
+        pw, ph, _pc, _pn = self.pc.dims
+        x = aux
+        pad_h = 0 if ph > 1 else self.padding_h
+        pad_w = 0 if pw > 1 else self.padding_w
+        kernel = params["kernel"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, kernel,
+            window_strides=(self.stride_h, self.stride_w),
+            padding=((pad_h, pad_h), (pad_w, pad_w)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = y + params["bias"].astype(y.dtype)
+        if self.relu:
+            y = jax.nn.relu(y)
+        return y, state
 
     def placement_signature(self):
         return (self.in_channels, self.out_channels, self.kernel_h,

@@ -59,6 +59,72 @@ class BatchNorm(Op):
 
         return [P("n", "h", "w", "c")]
 
+    def input_specs(self, pc=None):
+        from jax.sharding import PartitionSpec as P
+
+        pc = pc or self.pc
+        pw, ph, pcc, pn = pc.dims
+        if pcc != 1:
+            return None  # placed c-split would shard the running stats
+        n, h, w, _ = self.inputs[0].shape
+        if n % pn or h % ph or w % pw:
+            return None
+        return [P("n", "h", "w", None)]
+
+    def placement_signature(self):
+        # round 3: BatchNorm may join placement groups — its state is
+        # threaded through run_group (state_specs) and its statistics are
+        # grid-global via sharded_forward
+        return (self.channels, self.relu, self.eps, self.momentum)
+
+    def state_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        # per-channel running stats, replicated within the block (the
+        # placed grid never splits c — input_specs rejects that)
+        return {"mean": P(), "var": P()}
+
+    def placed_prelude(self, xs, train: bool):
+        """Batch statistics over the WHOLE placed block, not the local
+        shard: lax.pmean over the live grid axes keeps the framework
+        invariant (identical loss trajectories under any strategy) that
+        per-shard stats would break (the documented divergence from the
+        reference's per-task cuDNN stats).  Runs outside the group switch
+        (collectives are illegal inside branches)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        live = tuple(name for name, size in
+                     zip(self.AXIS_NAMES, self.pc.dims) if size > 1)
+        if not live or not train:
+            return None
+        (x,) = xs
+        xf = x.astype("float32")
+        mean = lax.pmean(jnp.mean(xf, axis=(0, 1, 2)), live)
+        mean2 = lax.pmean(jnp.mean(jnp.square(xf), axis=(0, 1, 2)), live)
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+        return mean, var
+
+    def sharded_forward(self, params, state, xs, train: bool, aux=None):
+        """Placed-grid forward: normalize with the block-global statistics
+        from placed_prelude (collective-free branch body)."""
+        import jax
+        import jax.numpy as jnp
+
+        if aux is None:
+            return self.forward(params, state, xs, train)
+        (x,) = xs
+        mean, var = aux
+        m = self.momentum
+        state = {"mean": m * state["mean"] + (1 - m) * mean,
+                 "var": m * state["var"] + (1 - m) * var}
+        inv = jax.lax.rsqrt(var + self.eps) * params["scale"]
+        shift = params["bias"] - mean * inv
+        y = x * inv.astype(x.dtype) + shift.astype(x.dtype)
+        if self.relu:
+            y = jax.nn.relu(y)
+        return y, state
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
         import jax.numpy as jnp

@@ -82,8 +82,8 @@ def placement_slot(op: Op, num_devices: int) -> Optional[Tuple[str, int]]:
         return None
     if op.placement_signature() is None or op.input_specs() is None:
         return None
-    if op.init_state():
-        return None  # stateful ops (BatchNorm) not supported placed
+    if op.init_state() and op.state_specs() is None:
+        return None  # stateful op without placed-state support
     # order-insensitive: a subset grid is placement-symmetric (which grid
     # point lands on which member device permutes shard routing only), so
     # the device SET decides placeability — e.g. a permuted-machine remap
@@ -150,6 +150,8 @@ def _out_positions(op: Op):
 
 def _hetero_eligible(op: Op) -> bool:
     """Can ``op`` join a heterogeneous (mixed-kind) placement group?"""
+    if op.init_state():
+        return False  # state threading is homogeneous-path only
     if not _params_block_replicated(op):
         return False
     if op.output_specs() is None or any(s is None
@@ -340,23 +342,32 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
 
 def run_group(machine, group: PlacementGroup,
               params_by_member: List[Dict],
-              inputs_by_member: List[List], train: bool):
-    """Execute a placement group jointly.  Returns, per member, the tuple
-    of its output arrays (each sliced from the group-stacked result, so it
-    physically lives on that member's device block)."""
+              inputs_by_member: List[List], train: bool,
+              states_by_member: Optional[List[Dict]] = None):
+    """Execute a placement group jointly.  Returns
+    ``(outs_by_member, new_states_by_member)``: per member, the tuple of
+    its output arrays (each sliced from the group-stacked result, so it
+    physically lives on that member's device block) and its new state
+    dict ({} for stateless members)."""
+    if states_by_member is None:
+        states_by_member = [{} for _ in group.members]
     if len({_signature(op) for op in group.members}) > 1:
         return _run_group_hetero(machine, group, params_by_member,
                                  inputs_by_member, train)
     return _run_group_homogeneous(machine, group, params_by_member,
-                                  inputs_by_member, train)
+                                  inputs_by_member, train,
+                                  states_by_member)
 
 
 def _run_group_homogeneous(machine, group: PlacementGroup,
                            params_by_member: List[Dict],
-                           inputs_by_member: List[List], train: bool):
-    """Same-signature members: params stacked leaf-wise over the group
-    axis with their inner sharding preserved; every branch shares one
-    output aval."""
+                           inputs_by_member: List[List], train: bool,
+                           states_by_member: List[Dict]):
+    """Same-signature members: params (and state, round 3 — lifting the
+    BatchNorm exclusion) stacked leaf-wise over the group axis with their
+    inner sharding preserved; every branch shares one output aval.
+    Branches run ``sharded_forward``, so grid-aware ops (spatial-halo
+    convs, global-stats BatchNorm) see the live inner mesh axes."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -373,36 +384,59 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
     slots = group.slots
     k_in = len(op0.input_specs())
 
+    def stack_leaf(*member_leaves):
+        by = dict(zip(slots, member_leaves))
+        z = jnp.zeros_like(member_leaves[0])
+        return jnp.stack([by.get(g, z) for g in range(G)])
+
     # ---- stack params along the group axis (zeros in unowned blocks) ----
     have_params = bool(params_by_member and params_by_member[0])
     if have_params:
-        def stack_leaf(*member_leaves):
-            by = dict(zip(slots, member_leaves))
-            z = jnp.zeros_like(member_leaves[0])
-            return jnp.stack([by.get(g, z) for g in range(G)])
-
         stacked = jax.tree.map(stack_leaf, *params_by_member)
         pspecs = {k: P("_pg", *spec)
                   for k, spec in op0.param_specs().items()}
     else:
         stacked = {}
         pspecs = {}
+    # ---- state threaded the same way (state_specs gates placement) ----
+    have_state = bool(states_by_member and states_by_member[0])
+    if have_state:
+        stacked_state = jax.tree.map(stack_leaf, *states_by_member)
+        sspecs = {k: P("_pg", *spec)
+                  for k, spec in op0.state_specs().items()}
+        state_keys = sorted(states_by_member[0])
+    else:
+        stacked_state = {}
+        sspecs = {}
+        state_keys = []
 
-    in_specs = (pspecs,) + tuple(op0.input_specs()) * len(ops)
-    out_specs = tuple(P("_pg", *spec) for spec in op0.output_specs())
+    in_specs = (pspecs, sspecs) + tuple(op0.input_specs()) * len(ops)
+    n_out = len(op0.output_specs())
+    out_specs = tuple(P("_pg", *spec) for spec in op0.output_specs()) + \
+        tuple(P("_pg", *op0.state_specs()[k]) for k in state_keys)
     flat_inputs = [x for xs in inputs_by_member for x in xs]
 
-    def body(sp, *flat):
+    def body(sp, st, *flat):
         local_params = jax.tree.map(lambda a: a[0], sp)
+        local_state = jax.tree.map(lambda a: a[0], st)
         gidx = lax.axis_index("_pg")
         xs_by_member = [list(flat[m * k_in:(m + 1) * k_in])
                         for m in range(len(ops))]
 
+        # collective preludes (halo exchange, cross-shard statistics) run
+        # for every member UNCONDITIONALLY — member inputs are replicated
+        # over the group axis, so this is uniform across device blocks;
+        # collectives inside the switch branches would be illegal SPMD
+        aux_by_member = [ops[m].placed_prelude(xs_by_member[m], train)
+                         for m in range(len(ops))]
+
         def branch_for(m):
             def br(_):
-                res, _st = ops[m].forward(local_params, {},
-                                          xs_by_member[m], train)
+                res, new_st = ops[m].sharded_forward(
+                    local_params, local_state, xs_by_member[m], train,
+                    aux=aux_by_member[m])
                 outs = res if isinstance(res, tuple) else (res,)
+                outs = outs + tuple(new_st[k] for k in state_keys)
                 return tuple(jnp.expand_dims(o, 0) for o in outs)
             return br
 
@@ -416,7 +450,12 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
         return lax.switch(gidx, branches, 0)
 
     res = unchecked_shard_map(body, mesh, in_specs, out_specs)(
-        stacked, *flat_inputs)
+        stacked, stacked_state, *flat_inputs)
+    new_states = []
+    for g in slots:
+        new_states.append({k: res[n_out + i][g]
+                           for i, k in enumerate(state_keys)})
+    res = res[:n_out]
     # Constrain each sliced member output to its pc's normalized sharding
     # (grid over the fast global axes, replicated over the rest).  This
     # splits the stacked->consumer regrid into an explicit gather over the
@@ -433,7 +472,7 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
                     v, machine.sharding(m.pc, m.AXIS_NAMES, spec))
             vals.append(v)
         out.append(tuple(vals))
-    return out
+    return out, new_states
 
 
 def _run_group_hetero(machine, group: PlacementGroup,
@@ -599,4 +638,4 @@ def _run_group_hetero(machine, group: PlacementGroup,
                     v, machine.sharding(m.pc, m.AXIS_NAMES, spec))
             vals.append(v)
         out.append(tuple(vals))
-    return out
+    return out, [{} for _ in ops]  # hetero members are stateless

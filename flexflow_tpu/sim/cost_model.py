@@ -26,6 +26,7 @@ class TpuChipPerf:
 
     peak_flops: float = 1.97e14      # bf16 MXU
     hbm_bandwidth: float = 8.1e11    # bytes/s
+    hbm_capacity: float = 1.6e10     # bytes per chip
     matmul_efficiency: float = 0.45  # achievable fraction on conv/matmul
     vector_efficiency: float = 0.8   # fraction of HBM bw on elementwise
     step_overhead: float = 3.0e-6    # per-kernel launch/fusion overhead
@@ -44,6 +45,28 @@ def shard_flops(op: Op, pc: ParallelConfig) -> float:
         return 3.0 * custom
     batch = op.output.shape[0]
     return 3.0 * op.flops_per_sample() * batch / pc.num_parts
+
+
+def pad_factor(op: Op, pc: ParallelConfig) -> float:
+    """Work multiplier for uneven shardings: XLA pads every shard to the
+    ceil size, so a 35-row extent split 2 ways computes 2*18 = 36 rows
+    (the reference's restriction transform pads identically,
+    conv_2d.cu:95-113).  1.0 for evenly-dividing grids."""
+    spec = op.output_specs()[0]
+    if spec is None:
+        return 1.0
+    sizes = dict(zip(op.AXIS_NAMES, pc.dims))
+    shape = op.output.shape
+    f = 1.0
+    for d, entry in enumerate(spec):
+        if entry is None or d >= len(shape):
+            continue
+        parts = 1
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            parts *= sizes.get(a, 1)
+        if parts > 1 and shape[d] % parts:
+            f *= (-(-shape[d] // parts) * parts) / shape[d]
+    return f
 
 
 def param_shard_fraction(op: Op, pc: ParallelConfig) -> float:
@@ -76,9 +99,10 @@ class AnalyticCostModel:
 
     def op_cost(self, op: Op, pc: ParallelConfig) -> float:
         n_parts = pc.num_parts
-        flops = shard_flops(op, pc)
-        io_elems = sum(t.size() for t in op.inputs) + \
-            sum(t.size() for t in op.all_outputs())
+        pad = pad_factor(op, pc)  # uneven shards do ceil-sized work
+        flops = shard_flops(op, pc) * pad
+        io_elems = (sum(t.size() for t in op.inputs) +
+                    sum(t.size() for t in op.all_outputs())) * pad
         # params stream 3x per step too (fwd read, dL/dW accumulate, dL/dx
         # re-read) — dominant for big-FC shards at small per-shard batch
         # (measured: the 9216x4096 FC at batch 64 costs ~the full-batch
@@ -103,17 +127,24 @@ class MeasuredCostModel:
 
     def __init__(self, cache_path: Optional[str] = None,
                  fallback: Optional[AnalyticCostModel] = None,
-                 repeats: int = 5, chain: int = 8, save_every: int = 32):
+                 repeats: int = 5, chain: int = 8, save_every: int = 32,
+                 dtype: str = "float32"):
         """``repeats`` = timed invocations (min taken); ``chain`` = op
         applications dependency-chained inside each invocation (amortizes
-        the tunnel's dispatch latency, see _measure)."""
+        the tunnel's dispatch latency, see _measure).  ``dtype`` is the
+        compute dtype the shard computations are timed in — calibration
+        against a bf16 training step must measure bf16 shard kernels
+        (MXU bf16 peak is ~4x f32); f32 keeps round-2 cache entries
+        valid."""
         self.cache_path = cache_path
         self.repeats = max(1, repeats)
         self.chain = max(1, chain)
+        self.dtype = dtype
         self.fallback = fallback or AnalyticCostModel()
         self.save_every = save_every
         self._dirty = 0
         self._warned_kinds = set()
+        self._kind_ratios: Dict[str, list] = {}
         self._cache: Dict[str, float] = {}
         # entries written by other timing protocols: never used for lookup,
         # but preserved verbatim on save so downgrading to an older binary
@@ -141,10 +172,30 @@ class MeasuredCostModel:
     def op_cost(self, op: Op, pc: ParallelConfig) -> float:
         key = self._key(op, pc)
         if key in self._cache:
-            return self._cache[key]
+            t = self._cache[key]
+            # cached measurements feed the kind anchor too, so a fully
+            # cache-served search still ranks unmeasurable candidates on
+            # the measured scale
+            self._kind_ratios.setdefault(type(op).__name__, []).append(
+                t / max(self.fallback.op_cost(op, pc), 1e-12))
+            return t
         t = self._measure(op, pc)
         if t is None:
+            # Unmeasurable shard (e.g. an uneven spatial split that
+            # local_clone cannot realize): anchor the analytic roofline to
+            # this op KIND's observed measured/analytic ratio, so uneven
+            # candidates rank on the same scale as their measured even
+            # siblings instead of on raw analytic numbers that can sit a
+            # clamp-width (10x) away.  NOT cached under a lookup key —
+            # an estimate must never be served as a measurement on later
+            # runs (nor feed the kind anchor), and an anchor that arrives
+            # later in the build should apply to later calls.
             t = self.fallback.op_cost(op, pc)
+            ratios = self._kind_ratios.get(type(op).__name__)
+            if ratios:
+                t *= sorted(ratios)[len(ratios) // 2]
+            self._foreign[f"estimate|{key}"] = t
+            return t
         else:
             # Sanity guard against tunnel-jitter spikes: a measurement far
             # outside the analytic roofline's plausibility band is
@@ -175,6 +226,8 @@ class MeasuredCostModel:
                         type(op).__name__, pc.dims, t, clamped, a)
                     self._foreign[f"preclamp|{key}"] = t
                     t = clamped
+            self._kind_ratios.setdefault(type(op).__name__, []).append(
+                t / max(a, 1e-12))
         self._cache[key] = t
         self._dirty += 1
         self._save()
@@ -193,8 +246,9 @@ class MeasuredCostModel:
         shapes = [t.shape for t in op.inputs] + [op.output.shape]
         sig = op.cost_signature()
         extra = f"|{sig}" if sig else ""
+        dt = "" if self.dtype == "float32" else f"|{self.dtype}"
         return (f"v{self._PROTOCOL}|{type(op).__name__}|{shapes}|{pc.dims}"
-                f"{extra}")
+                f"{extra}{dt}")
 
     def _measure(self, op: Op, pc: ParallelConfig) -> Optional[float]:
         import jax
@@ -206,7 +260,7 @@ class MeasuredCostModel:
         try:
             params = local.init_params(jax.random.PRNGKey(0))
             xs = [jnp.zeros(t.shape, "int32") if t.dtype == "int32"
-                  else jnp.ones(t.shape, "float32")
+                  else jnp.ones(t.shape, self.dtype)
                   for t in local.inputs]
             state = local.init_state()
 

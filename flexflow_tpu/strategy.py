@@ -82,6 +82,17 @@ class ParallelConfig:
         )
 
 
+def uneven_spatial_ok(extent: int, parts: int) -> bool:
+    """May a spatial extent split ``parts`` ways UNEVENLY (XLA pads the
+    short shard — the reference's restriction transform,
+    conv_2d.cu:95-113)?  Requires every ceil-sized shard non-empty:
+    near-extent splits would leave empty shards whose zero-byte comm edges
+    underprice a plan the hardware still pads everywhere.  Shared by the
+    search's candidate admission (sim/search.py) and the executor's
+    partition validation (ops/base.py) so the two can never disagree."""
+    return parts <= extent and (parts - 1) * -(-extent // parts) < extent
+
+
 class Strategy(dict):
     """Mapping of op name -> ParallelConfig for a whole model.
 

@@ -1,0 +1,47 @@
+"""Simulator-vs-chip calibration tripwire (VERDICT r2 #4).
+
+examples/strategies/calibration.json is generated on the TPU host by
+``python -m flexflow_tpu.apps.calibrate``: real DP step time (bench timed
+loop) vs the simulator's DP prediction under the measured cost model.
+This test fails if a committed calibration drifts outside +-30% — the
+bound the round-2 verdict set — keeping the search's absolute scale
+honest (the reference's dpCompTime self-report, scripts/simulator.cc:117,
+was never checked against anything).
+
+Round-3 actuals on v5e (bf16, bench shapes): inception 0.97, nmt 0.84,
+alexnet 0.73.  The residual under-prediction is a known, bounded bias:
+per-op shard timings cannot see the layout transitions XLA inserts
+between fusions of the real step (the reference's isolated cudaEvent
+microbenchmarks share this blindness).  What closed the rest of the gap —
+the optimizer parameter-stream pass and the input-cast cost — is now
+modeled in StrategySearch.simulate.
+"""
+
+import json
+import os
+
+ART = os.path.join(os.path.dirname(__file__), "..", "examples",
+                   "strategies", "calibration.json")
+
+
+def test_committed_calibration_within_30pct():
+    with open(ART) as f:
+        cal = json.load(f)
+    assert cal["models"], "empty calibration artifact"
+    for name, row in cal["models"].items():
+        r = row["ratio_measured"]
+        assert 0.7 <= r <= 1.3, \
+            f"{name}: measured-model ratio {r} outside +-30%"
+        # the analytic roofline is held to a looser band — it exists for
+        # chip-free searches and candidate ordering, not absolute time
+        assert 0.5 <= row["ratio_analytic"] <= 2.0, \
+            f"{name}: analytic ratio {row['ratio_analytic']} implausible"
+
+
+def test_calibration_covers_bench_models():
+    with open(ART) as f:
+        cal = json.load(f)
+    assert {"alexnet", "inception", "nmt"} <= set(cal["models"])
+    for row in cal["models"].values():
+        assert row["measured_step_s"] > 0
+        assert row["dtype"] == "bfloat16"

@@ -249,9 +249,9 @@ def test_moe_search_integration(machine8):
 
     m = _moe_lm(machine8)
     search = StrategySearch(m, machine8)
-    moe_idx = [i for i, op in enumerate(m.layers)
-               if type(op).__name__ == "MixtureOfExperts"][0]
-    cands = search.candidates[moe_idx]
+    moe_name = [op.name for op in m.layers
+                if type(op).__name__ == "MixtureOfExperts"][0]
+    cands = search.op_candidates(moe_name)
     assert any(pc.dims[0] > 1 for pc in cands), "no EP candidates generated"
     strategy, info = search.search(iters=1500, seed=7)
     assert info["best_time"] <= search.simulate(search.dp_assignment()) + 1e-12
