@@ -129,20 +129,9 @@ class Conv2D(Op):
         if aux is None:
             return self.forward(params, state, xs, train)
         pw, ph, _pc, _pn = self.pc.dims
-        x = aux
         pad_h = 0 if ph > 1 else self.padding_h
         pad_w = 0 if pw > 1 else self.padding_w
-        kernel = params["kernel"].astype(x.dtype)
-        y = lax.conv_general_dilated(
-            x, kernel,
-            window_strides=(self.stride_h, self.stride_w),
-            padding=((pad_h, pad_h), (pad_w, pad_w)),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
-        y = y + params["bias"].astype(y.dtype)
-        if self.relu:
-            y = jax.nn.relu(y)
-        return y, state
+        return self._conv_bias_relu(params, aux, pad_h, pad_w), state
 
     def placement_signature(self):
         return (self.in_channels, self.out_channels, self.kernel_h,
@@ -178,23 +167,28 @@ class Conv2D(Op):
 
         return P("n", "h", "w", "c")
 
-    def forward(self, params, state, xs: List, train: bool):
+    def _conv_bias_relu(self, params, x, pad_h: int, pad_w: int):
+        """The one conv/bias/relu body shared by the canonical forward and
+        the placed (pre-haloed) path, so the two can never diverge."""
         import jax
         from jax import lax
 
-        (x,) = xs
         kernel = params["kernel"].astype(x.dtype)
         y = lax.conv_general_dilated(
             x, kernel,
             window_strides=(self.stride_h, self.stride_w),
-            padding=((self.padding_h, self.padding_h),
-                     (self.padding_w, self.padding_w)),
+            padding=((pad_h, pad_h), (pad_w, pad_w)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         y = y + params["bias"].astype(y.dtype)
         if self.relu:
             y = jax.nn.relu(y)
-        return y, state
+        return y
+
+    def forward(self, params, state, xs: List, train: bool):
+        (x,) = xs
+        return self._conv_bias_relu(params, x, self.padding_h,
+                                    self.padding_w), state
 
     def local_clone(self, pc: ParallelConfig):
         pw, ph, pc_, pn = pc.dims

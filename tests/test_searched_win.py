@@ -1,0 +1,64 @@
+"""The searched strategy's wall-clock win on REAL AlexNet (VERDICT r2 #5).
+
+Round 2 demonstrated every >1x search win in simulation only (the one
+measured hybrid-vs-DP wall-clock was a tiny 2-conv toy).  This test runs
+the committed measured-search artifact (alexnet_8dev_measured.json: convs
+DP, FC stack channel-TP, tail block-placed) against pure DP on the real
+AlexNet topology at a CPU-scaled batch, on the 8-device virtual mesh.
+
+Why wall-clock CAN discriminate here (unlike the operator-overlap case,
+test_hetero_placement.py): the TP-on-FC win is a TOTAL-WORK reduction —
+under DP every device streams the full 230 MB FC weight stack ~3x per
+step, under channel-TP each streams only its slice — and total work is
+exactly what a shared-core virtual mesh measures.  Measured on this rig:
+~1.25x (committed in BASELINE.md).
+"""
+
+import time
+
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.data import synthetic_batches
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.models.alexnet import build_alexnet
+
+ARTIFACT = "examples/strategies/alexnet_8dev_measured.json"
+
+
+def _step_time(machine, strategy_file, iters=5, batch=16):
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", strategy_file) \
+        if strategy_file else ""
+    cfg = FFConfig(batch_size=batch, input_height=224, input_width=224,
+                   learning_rate=1e-4, seed=1, strategy_file=path)
+    ff = build_alexnet(cfg, machine)
+    data = synthetic_batches(machine, batch, 224, 224, mode="random",
+                             seed=2)
+    params, state = ff.init()
+    opt = ff.init_opt_state(params)
+    step = ff.make_train_step()
+    b = next(data)
+    for _ in range(2):
+        params, state, opt, loss = step(params, state, opt, *b)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state, opt, loss = step(params, state, opt, *b)
+    float(loss)
+    return (time.perf_counter() - t0) / iters, float(loss)
+
+
+def test_searched_strategy_beats_dp_wall_clock():
+    machine = MachineModel()
+    if machine.num_devices < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    t_dp, loss_dp = _step_time(machine, None)
+    t_searched, loss_s = _step_time(machine, ARTIFACT)
+    # same training semantics ...
+    assert loss_s == pytest.approx(loss_dp, rel=2e-3)
+    # ... measurably faster in wall-clock (measured ~1.25x on an idle rig;
+    # the assert leaves headroom for ambient load)
+    assert t_searched < t_dp, \
+        f"searched {t_searched:.2f}s vs DP {t_dp:.2f}s per step"
