@@ -47,15 +47,20 @@ class Conv2D(Op):
                              input.dtype, self, name)
 
     def _spatial_placeable(self, pc) -> bool:
-        """Can this conv run under a manual (shard_map) spatial grid?
-        Supported: SAME-padded stride-1 convs (odd kernel, p = (k-1)/2) —
-        the halo exchange then reduces to 'borrow (k-1)/2 edge rows from
-        each neighbor, zeros at the boundary', exactly the conv's own zero
-        padding (sharded_forward).  Everything else keeps the batch-only
-        placed form or the canonical GSPMD path (XLA's own halo
-        machinery)."""
+        """Can this conv run under a manual (shard_map) spatial/channel
+        grid?  Channel splits need no exchange at all: the input is
+        replicated over 'c' (the grid's c splits OUTPUT channels,
+        conv_2d.cu:72), each shard convolves its kernel slice, and
+        shard_map's transpose inserts the dL/dx psum over 'c' — the
+        reference's replica regions + BWD2 (linear.cu:570-603) for free.
+        Spatial splits are supported for SAME-padded stride-1 convs (odd
+        kernel, p = (k-1)/2) — the halo exchange then reduces to 'borrow
+        (k-1)/2 edge rows from each neighbor, zeros at the boundary',
+        exactly the conv's own zero padding (placed_prelude).  Everything
+        else keeps the batch-only placed form or the canonical GSPMD path
+        (XLA's own halo machinery)."""
         pw, ph, pcc, pn = pc.dims
-        if pcc != 1:
+        if pcc > 1 and self.out_channels % pcc:
             return False
         n, h, w, _ = self.inputs[0].shape
         for parts, extent, k, s, p in (
@@ -77,8 +82,10 @@ class Conv2D(Op):
 
         pc = pc or self.pc
         # placed execution (shard_map on a device block): batch-only
-        # grids always; spatial grids for the SAME/stride-1 family via the
-        # manual halo exchange in sharded_forward
+        # grids always; channel grids via the kernel's own 'c' sharding;
+        # spatial grids for the SAME/stride-1 family via the manual halo
+        # exchange in placed_prelude.  The input never shards over 'c'
+        # (replicated — the grid's c splits OUTPUT channels).
         if pc.dims[:3] == (1, 1, 1):
             return [P("n", None, None, None)]
         if self._spatial_placeable(pc):
@@ -123,9 +130,6 @@ class Conv2D(Op):
         """Placed-grid forward: consume the pre-haloed input from
         placed_prelude and convolve VALID on the sharded axes (their zero
         padding arrived with the halo)."""
-        import jax
-        from jax import lax
-
         if aux is None:
             return self.forward(params, state, xs, train)
         pw, ph, _pc, _pn = self.pc.dims

@@ -412,3 +412,46 @@ def test_placed_batchnorm_state_and_parity():
                                atol=1e-5)
     np.testing.assert_allclose(st_p["var"], st_c["var"], rtol=1e-3,
                                atol=1e-5)
+
+
+def test_placed_channel_conv_matches_canonical():
+    """Placed CHANNEL grids (round 3, completing the full 4-D placed
+    family): the kernel shards over the inner 'c' axis, the input stays
+    replicated over it, and shard_map's transpose supplies the dL/dx psum
+    (the reference's replica regions + BWD2).  Mixed spatial x channel
+    grids compose with the halo prelude."""
+    import numpy as np
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.data import synthetic_batches
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.strategy import Strategy
+
+    def build(strategies):
+        cfg = FFConfig(batch_size=16, input_height=16, input_width=16,
+                       learning_rate=1e-3, seed=3, strategies=strategies)
+        ff = FFModel(cfg, MachineModel())
+        img = ff.create_input((16, 16, 16, 8), name="image")
+        t = ff.conv2d("conv1", img, 32, 3, 3, 1, 1, 1, 1, relu=True)
+        t = ff.conv2d("conv2", t, 32, 3, 3, 1, 1, 1, 1, relu=True)
+        t = ff.flat("flat", t)
+        ff.softmax("softmax", ff.linear("fc1", t, 32, relu=False))
+        return ff
+
+    def losses(ff):
+        data = synthetic_batches(ff.machine, 16, 16, 16, mode="random",
+                                 seed=8, num_classes=32, channels=8)
+        return ff.fit(data, num_iterations=4, warmup=0,
+                      log=lambda *a: None)["loss"]
+
+    s = Strategy()
+    s["conv1"] = ParallelConfig((1, 1, 2, 2), (0, 1, 2, 3))  # channel x n
+    s["conv2"] = ParallelConfig((2, 1, 2, 1), (4, 5, 6, 7))  # w x channel
+    ff = build(s)
+    from flexflow_tpu.parallel.placement import placement_slot
+    for name, slot in (("conv1", ("block", 0)), ("conv2", ("block", 1))):
+        op = [o for o in ff.layers if o.name == name][0]
+        assert placement_slot(op, 8) == slot
+    np.testing.assert_allclose(losses(ff), losses(build(Strategy())),
+                               rtol=2e-4)
