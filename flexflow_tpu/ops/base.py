@@ -51,6 +51,30 @@ class Tensor:
         return f"Tensor(name={self.name!r}, shape={self.shape}, from={p})"
 
 
+def exchange_halo(x, axis_name: str, parts: int, k: int, dim: int):
+    """Borrow the (k-1)/2 edge rows of each neighbor along mesh axis
+    ``axis_name`` via ppermute and concatenate them onto tensor dim
+    ``dim``.  Boundary shards receive ppermute's zeros — the zero padding
+    of SAME-padded convs/pools.  Shared by every placed-grid op that
+    needs halos (Conv2D, Pool2D), so boundary semantics can never
+    diverge.  Must run OUTSIDE placement-group branch switches (see
+    Op.placed_prelude)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    r = (k - 1) // 2
+    if r == 0 or parts == 1:
+        return x
+    fwd = [(i, i + 1) for i in range(parts - 1)]
+    bwd = [(i + 1, i) for i in range(parts - 1)]
+    lo = lax.ppermute(
+        lax.slice_in_dim(x, x.shape[dim] - r, x.shape[dim], axis=dim),
+        axis_name, fwd)
+    hi = lax.ppermute(lax.slice_in_dim(x, 0, r, axis=dim),
+                      axis_name, bwd)
+    return jnp.concatenate([lo, x, hi], axis=dim)
+
+
 class Op:
     """Base operator: named, with inputs, one output, a ParallelConfig, and
     a pure functional forward.  (model.h:101-119 analog.)"""

@@ -99,30 +99,14 @@ class Conv2D(Op):
         outside the group switch (collectives are illegal inside); the
         reference exchanges the same halos through Legion's restriction
         partitions (conv_2d.cu:93-113)."""
-        import jax.numpy as jnp
-        from jax import lax
+        from flexflow_tpu.ops.base import exchange_halo
 
         pw, ph, _pc, _pn = self.pc.dims
         if ph == 1 and pw == 1:
             return None
         (x,) = xs
-
-        def halo(x, axis_name, parts, k, dim):
-            r = (k - 1) // 2
-            if r == 0 or parts == 1:
-                return x
-            fwd = [(i, i + 1) for i in range(parts - 1)]
-            bwd = [(i + 1, i) for i in range(parts - 1)]
-            lo = lax.ppermute(
-                lax.slice_in_dim(x, x.shape[dim] - r, x.shape[dim],
-                                 axis=dim),
-                axis_name, fwd)
-            hi = lax.ppermute(lax.slice_in_dim(x, 0, r, axis=dim),
-                              axis_name, bwd)
-            return jnp.concatenate([lo, x, hi], axis=dim)
-
-        x = halo(x, "h", ph, self.kernel_h, 1)
-        x = halo(x, "w", pw, self.kernel_w, 2)
+        x = exchange_halo(x, "h", ph, self.kernel_h, 1)
+        x = exchange_halo(x, "w", pw, self.kernel_w, 2)
         return x
 
     def sharded_forward(self, params, state, xs: List, train: bool,

@@ -40,13 +40,98 @@ class Pool2D(Op):
 
         return P("n", "h", "w", "c")
 
+    def _spatial_placeable(self, pc) -> bool:
+        """Placed spatial grids for AVG pools of the SAME/stride-1 family
+        (Inception's in-block 3x3 pools): the halo prelude exchanges both
+        the activation and a validity mask, reproducing the canonical
+        count-of-valid-positions semantics exactly.  MAX pools are
+        excluded from spatial placement (ppermute fills boundary halos
+        with zeros, not -inf)."""
+        pw, ph, pcc, pn = pc.dims
+        if self.pool_type != POOL_AVG:
+            return False
+        n, h, w, _ = self.inputs[0].shape
+        for parts, extent, k, s, p in (
+                (ph, h, self.kernel_h, self.stride_h, self.padding_h),
+                (pw, w, self.kernel_w, self.stride_w, self.padding_w)):
+            if parts == 1:
+                continue
+            if s != 1 or k % 2 == 0 or p != (k - 1) // 2:
+                return False
+            if extent % parts or (k - 1) // 2 > extent // parts:
+                return False
+        return True
+
     def input_specs(self, pc=None):
         from jax.sharding import PartitionSpec as P
 
         pc = pc or self.pc
-        if pc.dims[:3] != (1, 1, 1):
-            return None  # batch-only inner grids (as Conv2D)
-        return [P("n", None, None, None)]
+        pw, ph, pcc, pn = pc.dims
+        n, _, _, c = self.inputs[0].shape
+        cs = "c" if pcc > 1 else None
+        if (pcc > 1 and c % pcc) or n % pn:
+            return None
+        if (pw, ph) == (1, 1):
+            # batch (and optionally channel — pooling is per-channel)
+            return [P("n", None, None, cs)]
+        if self._spatial_placeable(pc):
+            return [P("n", "h", "w", cs)]
+        return None
+
+    def placed_prelude(self, xs, train: bool):
+        """Halo exchange for placed spatial AVG pools: the activation gets
+        real neighbor halos (shared exchange_halo); the validity mask that
+        reproduces the canonical count-of-valid-positions denominator is
+        built LOCALLY from the shard's grid position (zero halo iff
+        boundary shard) — no extra communication."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from flexflow_tpu.ops.base import exchange_halo
+
+        pw, ph, _pc, _pn = self.pc.dims
+        if ph == 1 and pw == 1:
+            return None
+        (x,) = xs
+        ones = jnp.ones_like(x)
+
+        def mask_halo(t, axis_name, parts, k, dim):
+            r = (k - 1) // 2
+            if r == 0 or parts == 1:
+                return t
+            idx = lax.axis_index(axis_name)
+            edge = lax.slice_in_dim(t, 0, r, axis=dim)
+            lo = edge * (idx > 0).astype(t.dtype)
+            hi = edge * (idx < parts - 1).astype(t.dtype)
+            return jnp.concatenate([lo, t, hi], axis=dim)
+
+        for axis_name, parts, k, dim in (("h", ph, self.kernel_h, 1),
+                                         ("w", pw, self.kernel_w, 2)):
+            x = exchange_halo(x, axis_name, parts, k, dim)
+            ones = mask_halo(ones, axis_name, parts, k, dim)
+        return x, ones
+
+    def sharded_forward(self, params, state, xs, train: bool, aux=None):
+        """Placed-grid forward: VALID avg pool over the pre-haloed
+        activation, divided by the pre-haloed validity count."""
+        import jax
+        from jax import lax
+
+        if aux is None:
+            return self.forward(params, state, xs, train)
+        x, ones = aux
+        pw, ph, _pc, _pn = self.pc.dims
+        pad_h = 0 if ph > 1 else self.padding_h
+        pad_w = 0 if pw > 1 else self.padding_w
+        window = (1, self.kernel_h, self.kernel_w, 1)
+        strides = (1, self.stride_h, self.stride_w, 1)
+        pads = ((0, 0), (pad_h, pad_h), (pad_w, pad_w), (0, 0))
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        y = s / cnt
+        if self.relu:
+            y = jax.nn.relu(y)
+        return y, state
 
     def placement_signature(self):
         return (self.kernel_h, self.kernel_w, self.stride_h, self.stride_w,

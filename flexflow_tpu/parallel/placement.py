@@ -569,6 +569,12 @@ def _run_group_hetero(machine, group: PlacementGroup,
     def body(sp, *flat):
         local_vec = sp[0]
         gidx = lax.axis_index("_pg")
+        # collective preludes run for every member unconditionally (same
+        # rationale as the homogeneous path: member inputs are replicated
+        # over the group axis; collectives inside branches are illegal)
+        aux_by_member = [
+            ops[m].placed_prelude(list(flat[offs[m]:offs[m + 1]]), train)
+            for m in range(len(ops))]
 
         def raw_branch(m):
             def br(_):
@@ -581,8 +587,9 @@ def _run_group_hetero(machine, group: PlacementGroup,
                                   .reshape(shape).astype(dtype))
                     off += size
                 p = jax.tree.unflatten(treedef, leaves)
-                res, _st = ops[m].forward(
-                    p, {}, list(flat[offs[m]:offs[m + 1]]), train)
+                res, _st = ops[m].sharded_forward(
+                    p, {}, list(flat[offs[m]:offs[m + 1]]), train,
+                    aux=aux_by_member[m])
                 return res if isinstance(res, tuple) else (res,)
             return br
 

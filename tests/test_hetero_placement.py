@@ -228,3 +228,46 @@ def test_hetero_overlap_structure(monkeypatch):
 
     assert colls(hlo_h) < colls(hlo_s), \
         f"collectives: hetero {colls(hlo_h)} vs serialized {colls(hlo_s)}"
+
+
+def test_hetero_group_runs_preludes():
+    """A spatial conv and a spatial AVG pool on disjoint blocks form a
+    heterogeneous group; the hetero path must run their collective
+    preludes (halo exchange) like the homogeneous path does — results
+    match the canonical run exactly."""
+    import numpy as np
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.data import synthetic_batches
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.ops.pool import POOL_AVG
+
+    def build(strategies):
+        cfg = FFConfig(batch_size=16, input_height=16, input_width=16,
+                       learning_rate=1e-3, seed=3, strategies=strategies)
+        ff = FFModel(cfg, MachineModel())
+        img = ff.create_input((16, 16, 16, 8), name="image")
+        a = ff.conv2d("convA", img, 16, 3, 3, 1, 1, 1, 1, relu=True)
+        b = ff.pool2d("poolB", img, 3, 3, 1, 1, 1, 1, pool_type=POOL_AVG,
+                      relu=False)
+        t = ff.concat("cat", [a, b])
+        t = ff.flat("flat", t)
+        ff.softmax("softmax", ff.linear("fc1", t, 32, relu=False))
+        return ff
+
+    def losses(ff):
+        data = synthetic_batches(ff.machine, 16, 16, 16, mode="random",
+                                 seed=8, num_classes=32, channels=8)
+        return ff.fit(data, num_iterations=4, warmup=0,
+                      log=lambda *a: None)["loss"]
+
+    s = Strategy()
+    s["convA"] = ParallelConfig((2, 2, 1, 1), (0, 1, 2, 3))
+    s["poolB"] = ParallelConfig((2, 2, 1, 1), (4, 5, 6, 7))
+    ff = build(s)
+    sched = ff._placement_schedule(frozenset())
+    mixed = [e for e in sched if isinstance(e, placement.PlacementGroup)
+             and len({type(m).__name__ for m in e.members}) > 1]
+    assert mixed, "conv+pool did not form a heterogeneous group"
+    np.testing.assert_allclose(losses(ff), losses(build(Strategy())),
+                               rtol=2e-4)

@@ -455,3 +455,44 @@ def test_placed_channel_conv_matches_canonical():
         assert placement_slot(op, 8) == slot
     np.testing.assert_allclose(losses(ff), losses(build(Strategy())),
                                rtol=2e-4)
+
+
+def test_placed_spatial_avg_pool_matches_canonical():
+    """Placed spatial AVG pool (Inception's in-block 3x3 stride-1 pools):
+    the halo prelude exchanges activation + validity mask, matching the
+    canonical count-of-valid-positions semantics bit-for-bit."""
+    import numpy as np
+
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.data import synthetic_batches
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.ops.pool import POOL_AVG
+    from flexflow_tpu.strategy import Strategy
+
+    def build(strategies):
+        cfg = FFConfig(batch_size=16, input_height=16, input_width=16,
+                       learning_rate=1e-3, seed=3, strategies=strategies)
+        ff = FFModel(cfg, MachineModel())
+        img = ff.create_input((16, 16, 16, 8), name="image")
+        t = ff.conv2d("conv1", img, 16, 3, 3, 1, 1, 1, 1, relu=True)
+        t = ff.pool2d("pool1", t, 3, 3, 1, 1, 1, 1, pool_type=POOL_AVG,
+                      relu=False)
+        t = ff.flat("flat", t)
+        ff.softmax("softmax", ff.linear("fc1", t, 32, relu=False))
+        return ff
+
+    def losses(ff):
+        data = synthetic_batches(ff.machine, 16, 16, 16, mode="random",
+                                 seed=8, num_classes=32, channels=8)
+        return ff.fit(data, num_iterations=4, warmup=0,
+                      log=lambda *a: None)["loss"]
+
+    s = Strategy()
+    s["pool1"] = ParallelConfig((2, 2, 1, 1), (4, 5, 6, 7))
+    ff = build(s)
+    from flexflow_tpu.parallel.placement import placement_slot
+    pool = [o for o in ff.layers if o.name == "pool1"][0]
+    assert placement_slot(pool, 8) == ("block", 1)
+    np.testing.assert_allclose(losses(ff), losses(build(Strategy())),
+                               rtol=2e-4)
