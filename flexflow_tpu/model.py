@@ -85,6 +85,18 @@ class FFModel:
         if len(perms) != 1:
             return machine
         perm = next(iter(perms))
+        # visible signal (round-3 ADVICE): the scan runs before layers are
+        # built, so a stale full-machine entry from a FOREIGN graph (a
+        # shared or checkpoint-loaded strategy dict) can rebuild the view
+        # on a permuted device order with unchanged semantics but changed
+        # ordinal-based tier pricing — make that decision loggable.
+        import logging
+
+        logging.getLogger(__name__).info(
+            "machine view rebuilt on the strategy file's whole-machine "
+            "device permutation %s (entries naming ops outside this "
+            "model also qualify — check the strategy dict if unexpected)",
+            perm)
         inv = [0] * n
         for i, d in enumerate(perm):
             inv[d] = i

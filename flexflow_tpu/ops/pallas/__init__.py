@@ -30,4 +30,19 @@ def flash_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
-__all__ = ["flash_attention", "flash_enabled"]
+def maxpool_enabled() -> bool:
+    """Policy gate for the Pallas max-pool backward: OFF by default.
+    Per-op it beats XLA's select_and_scatter ~2x (2.9 vs 5.0 ms on
+    Inception's two big pools, compiled-step profile), but end-to-end the
+    swap measures inside the run-to-run jitter band or slightly negative
+    (1926-1942 vs 1946 img/s across three full designs, round 4): the
+    forward sel plane costs a second pass over x that XLA's fused
+    reduce_window pipeline never pays.  Kept opt-in
+    (FLEXFLOW_TPU_MAXPOOL=1) as the measured-evidence answer to the
+    "write the pool kernel" roofline question — see the maxpool module
+    docstring and examples/profiles/README.md."""
+    return os.environ.get("FLEXFLOW_TPU_MAXPOOL", "").lower() \
+        in ("1", "true")
+
+
+__all__ = ["flash_attention", "flash_enabled", "maxpool_enabled"]

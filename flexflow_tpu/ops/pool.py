@@ -142,12 +142,39 @@ class Pool2D(Op):
 
         return [P("n", "h", "w", "c")]
 
+    def _use_pallas(self, x) -> bool:
+        """Route single-device LARGE max pools through the Pallas kernel
+        pair (ops/pallas/maxpool.py): backward reads dy + a selection
+        plane instead of running XLA's unvectorized select_and_scatter,
+        and the pool input drops out of the VJP residuals.  Small deep
+        pools (and multi-device grids) keep the XLA path: measured on the
+        compiled Inception step, XLA's fwd reduce_window there rides
+        producer fusions for ~free, which a standalone kernel pass cannot
+        beat (see the maxpool module docstring)."""
+        from flexflow_tpu.ops.pallas import maxpool_enabled
+        from flexflow_tpu.ops.pallas.maxpool import supported
+
+        _, h, w, _ = self.inputs[0].shape
+        return (maxpool_enabled()
+                and supported(self.kernel_h, self.kernel_w, self.stride_h,
+                              self.stride_w, self.padding_h, self.padding_w,
+                              self.pool_type)
+                and min(h, w) >= 48
+                and len(self.pc.devices) <= 1
+                and all(d == 1 for d in self.pc.dims))
+
     def forward(self, params, state, xs: List, train: bool):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         (x,) = xs
+        if self._use_pallas(x):
+            from flexflow_tpu.ops.pallas.maxpool import maxpool2d
+
+            return maxpool2d(x, self.kernel_h, self.kernel_w,
+                             self.padding_h, self.padding_w,
+                             relu=self.relu), state
         window = (1, self.kernel_h, self.kernel_w, 1)
         strides = (1, self.stride_h, self.stride_w, 1)
         pads = ((0, 0), (self.padding_h, self.padding_h),

@@ -57,27 +57,36 @@ def _axis_groups(pc: ParallelConfig, axis: int) -> Sequence[Tuple[int, ...]]:
     return groups
 
 
-def _spread(devs: Tuple[int, ...], topo: Topology) -> Tuple[int, int]:
-    """(G, p_in): ICI groups spanned and the largest per-group share."""
+def _spread(devs: Tuple[int, ...],
+            topo: Topology) -> Tuple[int, int, int]:
+    """(G, p_in, p_min): ICI groups spanned, the largest per-group share
+    (prices the intra-group ring) and the smallest (the worst-placed
+    device, which pushes the most of its volume across DCN)."""
     counts: dict = {}
     for d in devs:
         g = d // topo.devices_per_ici_group
         counts[g] = counts.get(g, 0) + 1
-    return len(counts), max(counts.values())
+    return len(counts), max(counts.values()), min(counts.values())
 
 
 def _worst_group(pc: ParallelConfig, axis: int,
                  topo: Topology) -> Tuple[int, ...]:
-    """The axis-``axis`` group spanning the most ICI groups (ties: fewest
-    devices in its largest group) — the slice that prices the op."""
+    """The axis-``axis`` group spanning the most ICI groups (ties: most
+    devices beyond the smallest per-group share — the _alltoall DCN
+    volume — then fewest in the largest share) — the slice that prices
+    the op."""
     if (_spread(tuple(pc.devices), topo)[0] <= 1):
         # whole device set inside one ICI group (the common offline-search
         # case) — every axis group is pure-ICI, skip the enumeration
         size = pc.dims[axis]
         stride = math.prod(pc.dims[:axis])
         return tuple(pc.devices[j * stride] for j in range(size))
-    return max(_axis_groups(pc, axis),
-               key=lambda g: ((lambda s: (s[0], -s[1]))(_spread(g, topo))))
+
+    def badness(g):
+        G, p_in, p_min = _spread(g, topo)
+        return (G, len(g) - p_min, -p_in)
+
+    return max(_axis_groups(pc, axis), key=badness)
 
 
 def _allreduce(vol_bytes: float, devs: Tuple[int, ...],
@@ -88,7 +97,7 @@ def _allreduce(vol_bytes: float, devs: Tuple[int, ...],
     p = len(devs)
     if p <= 1 or vol_bytes <= 0:
         return 0.0
-    G, p_in = _spread(devs, topo)
+    G, p_in, _ = _spread(devs, topo)
     t = 0.0
     if p_in > 1:
         t += (2.0 * (p_in - 1) / p_in * vol_bytes / topo.ici_bandwidth
@@ -103,17 +112,20 @@ def _allreduce(vol_bytes: float, devs: Tuple[int, ...],
 def _alltoall(vol_bytes: float, devs: Tuple[int, ...],
               topo: Topology) -> float:
     """All-to-all of one shard's ``vol_bytes`` over ``devs``, volume split
-    by destination tier: (p_in-1)/p stays on ICI, (p-p_in)/p crosses DCN."""
+    by destination tier: the worst-placed device (smallest ICI group,
+    round-3 ADVICE) keeps (p_min-1)/p on ICI and pushes (p-p_min)/p
+    across DCN; the intra-group ring term is priced at the largest
+    share."""
     p = len(devs)
     if p <= 1 or vol_bytes <= 0:
         return 0.0
-    G, p_in = _spread(devs, topo)
+    G, p_in, p_min = _spread(devs, topo)
     t = 0.0
     if p_in > 1:
         t += ((p_in - 1) / p * vol_bytes / topo.ici_bandwidth
               + (p_in - 1) * topo.ici_latency)
     if G > 1:
-        t += ((p - p_in) / p * vol_bytes / topo.dcn_bandwidth
+        t += ((p - p_min) / p * vol_bytes / topo.dcn_bandwidth
               + (G - 1) * topo.dcn_latency)
     return t
 

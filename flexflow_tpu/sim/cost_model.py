@@ -145,6 +145,10 @@ class MeasuredCostModel:
         self._dirty = 0
         self._warned_kinds = set()
         self._kind_ratios: Dict[str, list] = {}
+        # keys that already contributed a ratio: cache-hit lookups for
+        # identically-keyed ops must not append duplicates, which would
+        # skew the per-kind median toward repeated shapes (round-3 ADVICE)
+        self._kind_seen: set = set()
         self._cache: Dict[str, float] = {}
         # entries written by other timing protocols: never used for lookup,
         # but preserved verbatim on save so downgrading to an older binary
@@ -173,11 +177,13 @@ class MeasuredCostModel:
         key = self._key(op, pc)
         if key in self._cache:
             t = self._cache[key]
-            # cached measurements feed the kind anchor too, so a fully
-            # cache-served search still ranks unmeasurable candidates on
-            # the measured scale
-            self._kind_ratios.setdefault(type(op).__name__, []).append(
-                t / max(self.fallback.op_cost(op, pc), 1e-12))
+            # cached measurements feed the kind anchor too (once per key),
+            # so a fully cache-served search still ranks unmeasurable
+            # candidates on the measured scale
+            if key not in self._kind_seen:
+                self._kind_seen.add(key)
+                self._kind_ratios.setdefault(type(op).__name__, []).append(
+                    t / max(self.fallback.op_cost(op, pc), 1e-12))
             return t
         t = self._measure(op, pc)
         if t is None:
@@ -226,8 +232,10 @@ class MeasuredCostModel:
                         type(op).__name__, pc.dims, t, clamped, a)
                     self._foreign[f"preclamp|{key}"] = t
                     t = clamped
-            self._kind_ratios.setdefault(type(op).__name__, []).append(
-                t / max(a, 1e-12))
+            if key not in self._kind_seen:
+                self._kind_seen.add(key)
+                self._kind_ratios.setdefault(type(op).__name__, []).append(
+                    t / max(a, 1e-12))
         self._cache[key] = t
         self._dirty += 1
         self._save()

@@ -450,6 +450,7 @@ class StrategySearch:
         hbm_cap = perf.hbm_capacity
         ints: List[int] = [n_dev, topo.devices_per_ici_group, len(self.ops)]
         costs: List[float] = []
+        cost_pairs: List[tuple] = []  # (index into costs, op, pc)
         replicas: List[float] = []
         colls: List[float] = []
         pbytes: List[float] = []
@@ -515,7 +516,8 @@ class StrategySearch:
                     assert len(in_rects) == len(producers)
                     for r in in_rects:
                         ints.extend(r)
-                costs.append(self.cost_model.op_cost(op, pc))
+                cost_pairs.append((len(costs), op, pc))
+                costs.append(0.0)  # resolved in the two-pass loop below
                 replicas.append(self._param_replicas(op, pc))
                 colls.append(collective_cost(op, pc, topo))
             # shared weights (param_key) are synced once per step, not once
@@ -525,6 +527,21 @@ class StrategySearch:
             else:
                 seen_param_keys.add(op.param_key)
                 pbytes.append(float(op.param_bytes()))
+        # two-pass cost resolution (round-3 ADVICE), measured models only
+        # (sniffed like the flush below — an analytic model has no cache
+        # or anchors to warm, so the extra pass would just double its
+        # work): the first pass runs every measurement and collects the
+        # per-kind measured/analytic anchor ratios, the second serves
+        # cached values and re-derives estimates for unmeasurable
+        # candidates against the now-COMPLETE anchors — so an uneven
+        # split encountered before any measured sibling of its kind no
+        # longer falls back to an unanchored analytic number.  Estimates
+        # are never cached, so the re-derivation is what lands in costs.
+        if hasattr(self.cost_model, "flush"):
+            for _, op, pc in cost_pairs:
+                self.cost_model.op_cost(op, pc)
+        for i, op, pc in cost_pairs:
+            costs[i] = self.cost_model.op_cost(op, pc)
         if hasattr(self.cost_model, "flush"):
             self.cost_model.flush()
         # un-silence the pruning (VERDICT weak #5): what the search space
@@ -545,15 +562,32 @@ class StrategySearch:
         # (calibration on v5e: NMT's ~1 GB of fp32 params cost ~4 ms/step
         # of pure HBM streaming that no per-op compute time contains).
         # Every device updates its full replica of each param it holds:
-        # plain SGD reads p,g and writes p (3x); momentum SGD also reads
-        # and writes v (5x).  Sharded params stream only their shard, but
+        # the update reads p,g and writes p (3x the param footprint) plus
+        # one read+write of every optimizer-state buffer — derived from
+        # the model's ACTUAL abstract opt state (round-3 ADVICE: an
+        # identity check against FFModel.init_opt_state mispriced any
+        # richer override, e.g. Adam-like two-buffer states, at the
+        # momentum rate).  Sharded params stream only their shard, but
         # DP — where this matters — replicates everything; charge the
         # whole footprint (upper bound for TP shards).
         total_param_bytes = sum(pbytes)  # pbytes is already once-per-key
-        passes = 3.0 if type(self.model).init_opt_state \
-            is not FFModel.init_opt_state else 5.0
-        self._opt_stream_s = passes * total_param_bytes \
+        opt_bytes = self._opt_state_bytes(total_param_bytes)
+        self._opt_stream_s = (3.0 * total_param_bytes + 2.0 * opt_bytes) \
             / (perf.hbm_bandwidth * perf.vector_efficiency)
+
+    def _opt_state_bytes(self, total_param_bytes: float) -> float:
+        """Bytes of the model's optimizer state, from jax.eval_shape over
+        the abstract params — no materialization.  Falls back to the
+        momentum assumption (state == params) if abstraction fails."""
+        try:
+            import jax
+
+            params_abs, _ = self.model.init(abstract=True)
+            opt_abs = jax.eval_shape(self.model.init_opt_state, params_abs)
+            return float(sum(leaf.size * leaf.dtype.itemsize
+                             for leaf in jax.tree.leaves(opt_abs)))
+        except Exception:  # virtual machines without a live mesh, etc.
+            return total_param_bytes
 
     @staticmethod
     def _param_replicas(op: Op, pc: ParallelConfig) -> float:

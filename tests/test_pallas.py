@@ -274,3 +274,81 @@ def test_lm_head_fusion_vocab_tp(machine8):
     for a, c in zip(base_g, fused_g):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# Pallas max-pool backward (ops/pallas/maxpool.py): parity with XLA
+# reduce_window autodiff — including first-max tie-breaking (integer-valued
+# inputs make ties certain) and the fused-ReLU sentinel path.
+
+
+def _ref_maxpool(x, kh, kw, ph, pw, relu):
+    from jax import lax
+
+    y = lax.reduce_window(x, -jnp.inf, lax.max, (1, kh, kw, 1),
+                          (1, 2, 2, 1), ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    return jax.nn.relu(y) if relu else y
+
+
+@pytest.mark.parametrize("n,h,w,c,k,p,relu", [
+    (2, 9, 9, 3, 3, 0, False),    # odd extents, VALID (Inception pools)
+    (2, 16, 16, 5, 3, 0, True),   # even extents + fused relu
+    (3, 15, 17, 4, 3, 1, True),   # pad 1 (ResNet/DenseNet pool1), h != w
+    (2, 12, 12, 3, 2, 0, False),  # 2x2 (VGG pools)
+    (1, 8, 8, 2, 3, 1, False),    # tiny single-sample
+    (2, 23, 19, 6, 3, 0, True),   # ragged H/W blocks
+])
+def test_maxpool_parity(n, h, w, c, k, p, relu):
+    from flexflow_tpu.ops.pallas.maxpool import maxpool2d
+
+    rng = np.random.RandomState(0)
+    # small-integer inputs: every window has ties, negatives exercise the
+    # relu-clamped sentinel
+    x = jnp.asarray(rng.randint(-3, 4, size=(n, h, w, c)), jnp.float32)
+    g = jnp.asarray(rng.randn(n, *_ref_maxpool(x, k, k, p, p, relu).shape[1:3],
+                              c), jnp.float32)
+
+    def f_pallas(x):
+        return maxpool2d(x, k, k, p, p, relu, interpret=True)
+
+    def f_ref(x):
+        return _ref_maxpool(x, k, k, p, p, relu)
+
+    np.testing.assert_array_equal(np.asarray(f_pallas(x)),
+                                  np.asarray(f_ref(x)))
+    gp = jax.grad(lambda x: jnp.vdot(f_pallas(x), g))(x)
+    gr = jax.grad(lambda x: jnp.vdot(f_ref(x), g))(x)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_maxpool_supported_gate():
+    from flexflow_tpu.ops.pallas.maxpool import supported
+
+    assert supported(3, 3, 2, 2, 0, 0)
+    assert supported(3, 3, 2, 2, 1, 1)
+    assert supported(2, 2, 2, 2, 0, 0)
+    assert not supported(3, 3, 1, 1, 1, 1)        # stride-1 pools stay XLA
+    assert not supported(5, 5, 2, 2, 0, 0)        # unsupported kernel size
+    assert not supported(3, 3, 2, 2, 0, 0, "avg")  # avg pools stay XLA
+
+
+def test_pool2d_routes_through_pallas_when_enabled(monkeypatch):
+    """Pool2D.forward takes the kernel path under the env gate and the
+    result matches the XLA path bit-for-bit (interpret mode)."""
+    from flexflow_tpu.ops.base import Tensor
+    from flexflow_tpu.ops.pool import Pool2D
+    from flexflow_tpu.strategy import ParallelConfig
+
+    monkeypatch.setenv("FLEXFLOW_TPU_MAXPOOL", "1")
+    t = Tensor((2, 64, 64, 3))
+    op = Pool2D("p", ParallelConfig((1, 1, 1, 1), (0,)), t, 3, 3, 2, 2,
+                0, 0, relu=True)
+    assert op._use_pallas(None)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randint(-2, 3, size=(2, 64, 64, 3)), jnp.float32)
+    y_pal, _ = op.forward({}, {}, [x], train=True)
+    monkeypatch.setenv("FLEXFLOW_TPU_MAXPOOL", "0")
+    assert not op._use_pallas(None)
+    y_xla, _ = op.forward({}, {}, [x], train=True)
+    np.testing.assert_array_equal(np.asarray(y_pal), np.asarray(y_xla))
