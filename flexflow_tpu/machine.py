@@ -252,6 +252,23 @@ class MachineModel:
             self._mesh_cache[key] = mesh
         return mesh
 
+    def flat_mesh(self):
+        """(N,)-mesh over axis ``_dev`` in canonical order — the dispatch
+        mesh of set-family placement groups (parallel/placement.py):
+        arbitrary device lists cannot be a mesh reordering (XLA admits ONE
+        device assignment per computation — block/stride meshes work only
+        because they RESHAPE the canonical order), so each device instead
+        switches on its own id to the (member, grid point) the strategy
+        assigned it."""
+        key = ("_flat",)
+        mesh = self._mesh_cache.get(key)
+        if mesh is None:
+            from jax.sharding import Mesh
+
+            mesh = Mesh(self._dev_array((self.num_devices,)), ("_dev",))
+            self._mesh_cache[key] = mesh
+        return mesh
+
     def input_sharding(self, pc: ParallelConfig,
                        axis_names: Tuple[str, ...], spec):
         """Sharding for *placing jit inputs* (params, optimizer state).
@@ -440,12 +457,21 @@ class MachineModel:
                 f"replicated (1-device speed)")
             return self.replicated()
         if (pc.dims, pc.devices) not in self._honored:
+            # since round 4 every duplicate-free list of a placed-capable
+            # op is honored via a placement group (block/stride/set
+            # families, parallel/placement.py) — reaching here means the
+            # OP cannot run placed (no placed support for this grid /
+            # stateful without state specs) or the list itself is
+            # unplaceable (duplicates)
             self._warn_once(
                 ("norm", pc.dims, pc.devices),
-                f"devices {pc.devices} for grid {pc.dims} are not an "
-                f"aligned placeable block; the device list is normalized "
+                f"devices {pc.devices} for grid {pc.dims}: op cannot "
+                f"execute placed — duplicate devices, or an op that is "
+                f"not point-local under this grid (spatial halos / "
+                f"cross-shard stats / state admit only block- or "
+                f"stride-shaped lists); the device list is normalized "
                 f"onto the canonical order (placement not honored — see "
-                f"parallel/placement.py for the supported forms)")
+                f"parallel/placement.py placement_slot/_set_eligible)")
         # Normalized realization: XLA admits exactly one device assignment
         # per computation, so a permuted/subset device list is mapped onto
         # the canonical order, with the devices the grid doesn't occupy

@@ -174,6 +174,17 @@ class Op:
         Default: the plain forward."""
         return self.forward(params, state, xs, train)
 
+    def placed_local(self) -> bool:
+        """True when this op's placed execution under ITS grid is point-
+        local (no collective prelude; sharded_forward == forward) — the
+        eligibility bar for set-family per-device dispatch
+        (parallel/placement.py).  Ops that don't override the placed
+        hooks are local by construction; overriders refine per grid
+        (e.g. conv/pool: spatial parts == 1)."""
+        cls = type(self)
+        return (cls.placed_prelude is Op.placed_prelude
+                and cls.sharded_forward is Op.sharded_forward)
+
     def state_specs(self):
         """PartitionSpec per state leaf for PLACED execution (state
         stacked over the placement-group axis like params).  None -> a

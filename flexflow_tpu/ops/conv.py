@@ -126,6 +126,11 @@ class Conv2D(Op):
                 self.kernel_w, self.stride_h, self.stride_w,
                 self.padding_h, self.padding_w, self.relu)
 
+    def placed_local(self) -> bool:
+        # point-local exactly when no spatial halos are needed
+        pw, ph, _pc, _pn = self.pc.dims
+        return pw == 1 and ph == 1
+
     def regrid_input_specs(self):
         from jax.sharding import PartitionSpec as P
 

@@ -137,6 +137,11 @@ class Pool2D(Op):
         return (self.kernel_h, self.kernel_w, self.stride_h, self.stride_w,
                 self.padding_h, self.padding_w, self.pool_type, self.relu)
 
+    def placed_local(self) -> bool:
+        # point-local exactly when no spatial halos are needed
+        pw, ph, _pc, _pn = self.pc.dims
+        return pw == 1 and ph == 1
+
     def regrid_input_specs(self):
         from jax.sharding import PartitionSpec as P
 

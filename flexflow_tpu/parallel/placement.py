@@ -59,8 +59,9 @@ from flexflow_tpu.ops.base import Op
 @dataclasses.dataclass
 class PlacementGroup:
     """A set of independent ops executing concurrently on disjoint device
-    subsets (contiguous blocks, or constant-stride sets when
-    ``strided``)."""
+    subsets: contiguous blocks, constant-stride sets (``strided``), or —
+    the general "set" family (round 4) — arbitrary duplicate-free device
+    lists honored in their NAMED order via ``device_rows``."""
 
     members: List[Op]
     indices: List[int]        # layer indices of members
@@ -68,29 +69,47 @@ class PlacementGroup:
     subset_size: int          # devices per member (= pc.num_parts)
     n_groups: int             # machine blocks of that size
     strided: bool = False     # stride family: slot b owns {b + j*(N/P)}
+    #: set family: row g of the placement mesh is exactly device_rows[g]
+    #: (member order; the machine pads remaining devices as zero rows)
+    device_rows: Optional[List[Tuple[int, ...]]] = None
 
 
-def placement_slot(op: Op, num_devices: int) -> Optional[Tuple[str, int]]:
+def placement_slot(op: Op, num_devices: int):
     """("block", g) when ``op``'s ParallelConfig names the contiguous
     device block ``[g*P, (g+1)*P)``; ("stride", b) when it names the
     constant-stride set ``{b + j*(N/P)}`` (VERDICT r2 #3b, e.g.
-    ``devices=(0,2,4,6)``); None when the list is not a placeable strict
-    subset of the machine."""
+    ``devices=(0,2,4,6)``); ("set", devices) — round 4, closing
+    SURVEY §2.4 — for ANY other duplicate-free list, honored in its
+    NAMED order on a mesh whose rows are the listed devices (the
+    reference's RnnMapper pins a task to any named GPU,
+    nmt/rnn_mapper.cc:131-135).  None when the op cannot run placed
+    (no placed support for this grid, duplicates, or a grid that does
+    not divide the machine) — those normalize with a warning."""
     pc = op.pc
     p = pc.num_parts
-    if num_devices <= 1 or p >= num_devices or num_devices % p:
+    if num_devices <= 1 or p > num_devices or num_devices % p:
         return None
     if op.placement_signature() is None or op.input_specs() is None:
         return None
     if op.init_state() and op.state_specs() is None:
         return None  # stateful op without placed-state support
-    # order-insensitive: a subset grid is placement-symmetric (which grid
-    # point lands on which member device permutes shard routing only), so
-    # the device SET decides placeability — e.g. a permuted-machine remap
-    # listing a block in reversed order stays honored
-    devs = tuple(sorted(pc.devices))
-    if len(set(devs)) != p:
+    if len(set(pc.devices)) != p:
         return None
+    if p == num_devices:
+        # full-machine lists: canonical order is the normal (free) path;
+        # a single foreign permutation is absorbed by the machine-view
+        # rebuild (model._permuted_machine_view) before ops are built, so
+        # reaching here non-canonical means CONFLICTING permutations —
+        # honor each via per-device dispatch (resharding at entry/exit)
+        if pc.devices == tuple(range(num_devices)):
+            return None
+        return ("set", tuple(pc.devices)) if _set_eligible(op) else None
+    # block/stride detection is order-insensitive: a strict-subset grid is
+    # placement-symmetric (which grid point lands on which member device
+    # permutes shard routing only), so the device SET decides the family —
+    # e.g. a permuted-machine remap listing a block in reversed order
+    # stays a plain block
+    devs = tuple(sorted(pc.devices))
     d0 = devs[0]
     g, rem = divmod(d0, p)
     if rem == 0 and devs == tuple(range(g * p, (g + 1) * p)):
@@ -98,7 +117,28 @@ def placement_slot(op: Op, num_devices: int) -> Optional[Tuple[str, int]]:
     s = num_devices // p
     if d0 < s and devs == tuple(d0 + j * s for j in range(p)):
         return ("stride", d0)
-    return None
+    return ("set", tuple(pc.devices)) if _set_eligible(op) else None
+
+
+def _set_eligible(op: Op) -> bool:
+    """Can ``op`` run under set-family per-device dispatch?  The runner
+    slices every operand per grid point and calls plain ``forward``, so
+    the op must be point-local: no collective prelude or grid-aware
+    sharded_forward for its grid (``placed_local``), no state, and every
+    spec entry a single axis name or None (the slicer's vocabulary)."""
+    if not op.placed_local() or op.init_state():
+        return False
+
+    def ok(spec):
+        return spec is not None and all(
+            e is None or isinstance(e, str) for e in tuple(spec))
+
+    outs = op.output_specs()
+    if outs is None or not all(ok(s) for s in outs):
+        return False
+    if not all(ok(s) for s in op.input_specs()):
+        return False
+    return all(ok(s) for s in op.param_specs().values())
 
 
 def _signature(op: Op) -> tuple:
@@ -207,6 +247,15 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
     open_by_grid: Dict[tuple, List[dict]] = {}
     group_of: Dict[int, int] = {}
 
+    def conflicts(fam, g, slots):
+        """Can slot ``g`` not coexist with ``slots``?  Block/stride slots
+        collide on equality; set-family slots are device tuples and
+        collide on any overlap."""
+        if fam == "set":
+            gs = set(g)
+            return any(gs & set(s) for s in slots)
+        return g in slots
+
     def join(grp, i, g, elig, pos):
         grp["indices"].append(i)
         grp["slots"].append(g)
@@ -224,11 +273,13 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
             continue
         fam, g = slot
         sig = _signature(op)
-        elig = _hetero_eligible(op)
+        # set-family groups are homogeneous-only: their per-device switch
+        # slices operands by ONE shared spec set
+        elig = fam != "set" and _hetero_eligible(op)
         pos = _out_positions(op) if elig else None
         placed = False
         for grp in open_by_sig.get(sig, []):
-            if grp["family"] != fam or g in grp["slots"]:
+            if grp["family"] != fam or conflicts(fam, g, grp["slots"]):
                 continue
             if any(m in anc[i] for m in grp["indices"]):
                 continue  # dependency path member -> op
@@ -238,7 +289,7 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         if not placed and elig:
             for grp in open_by_grid.get(
                     (op.pc.dims, op.AXIS_NAMES, fam), []):
-                if not grp["hetero_ok"] or g in grp["slots"]:
+                if not grp["hetero_ok"] or conflicts(fam, g, grp["slots"]):
                     continue
                 if any(m in anc[i] for m in grp["indices"]):
                     continue
@@ -309,13 +360,18 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
                 schedule.append(node_members[nid][0])
             else:
                 grp = groups[gid]
+                is_set = grp["family"] == "set"
                 schedule.append(PlacementGroup(
                     members=[layers[i] for i in grp["indices"]],
                     indices=list(grp["indices"]),
-                    slots=list(grp["slots"]),
+                    # set family: members occupy mesh rows 0..m-1 in join
+                    # order; the remaining rows hold the unlisted devices
+                    slots=(list(range(len(grp["indices"]))) if is_set
+                           else list(grp["slots"])),
                     subset_size=grp["subset"],
                     n_groups=num_devices // grp["subset"],
-                    strided=grp["family"] == "stride"))
+                    strided=grp["family"] == "stride",
+                    device_rows=(list(grp["slots"]) if is_set else None)))
             for s in nsucc[nid]:
                 indeg[s] -= 1
                 if indeg[s] == 0:
@@ -351,12 +407,161 @@ def run_group(machine, group: PlacementGroup,
     dict ({} for stateless members)."""
     if states_by_member is None:
         states_by_member = [{} for _ in group.members]
+    if group.device_rows is not None:
+        assert all(not s for s in states_by_member), \
+            "set-family groups are stateless (placement_slot gates this)"
+        return _run_group_set(machine, group, params_by_member,
+                              inputs_by_member, train)
     if len({_signature(op) for op in group.members}) > 1:
         return _run_group_hetero(machine, group, params_by_member,
                                  inputs_by_member, train)
     return _run_group_homogeneous(machine, group, params_by_member,
                                   inputs_by_member, train,
                                   states_by_member)
+
+
+def set_group_assignment(group: PlacementGroup,
+                         axis_names: Tuple[str, ...]):
+    """{device: (member, grid-linear, per-axis index dict)} of a
+    set-family group — the contract the per-device dispatch executes:
+    member m's grid point j (dim 0 fastest) runs on
+    ``device_rows[m][j]``, the reference's RnnMapper semantics
+    (nmt/rnn_mapper.cc:131-135)."""
+    out = {}
+    dims = group.members[0].pc.dims
+    for m, row in enumerate(group.device_rows):
+        for j, dev in enumerate(row):
+            rem, idx = j, {}
+            for a, d in zip(axis_names, dims):
+                idx[a] = rem % d
+                rem //= d
+            out[dev] = (m, j, idx)
+    return out
+
+
+def _point_slice(arr, spec, sizes, idx):
+    """Static slice of one grid point's block of ``arr`` per its
+    PartitionSpec (single-axis-or-None entries — _set_eligible's bar)."""
+    entries = tuple(spec) + (None,) * (arr.ndim - len(tuple(spec)))
+    sl = []
+    for d, e in enumerate(entries):
+        parts = sizes.get(e, 1) if e is not None else 1
+        if parts == 1:
+            sl.append(slice(None))
+        else:
+            n = arr.shape[d] // parts
+            sl.append(slice(idx[e] * n, (idx[e] + 1) * n))
+    return arr[tuple(sl)]
+
+
+def _assemble(shards, spec, sizes, axis_names, dims):
+    """Inverse of _point_slice over the whole grid: stitch the per-point
+    shards (grid-linear order, dim 0 fastest) back into the global
+    tensor.  A grid axis absent from the spec replicates the output —
+    keep the first copy."""
+    import jax.numpy as jnp
+
+    entries = tuple(spec)
+    dim_of = {e: d for d, e in enumerate(entries) if e is not None}
+    lists = list(shards)
+    for a, p in zip(axis_names, dims):
+        if p == 1:
+            continue
+        d = dim_of.get(a)
+        nxt = []
+        for g in range(len(lists) // p):
+            chunk = lists[g * p:(g + 1) * p]
+            nxt.append(jnp.concatenate(chunk, axis=d)
+                       if d is not None else chunk[0])
+        lists = nxt
+    assert len(lists) == 1
+    return lists[0]
+
+
+def _run_group_set(machine, group: PlacementGroup,
+                   params_by_member: List[Dict],
+                   inputs_by_member: List[List], train: bool):
+    """Arbitrary-device-list members (round 4, closing SURVEY §2.4): an
+    irregular list like ``(0,3,5,6)`` cannot be a mesh reordering (XLA
+    admits ONE device assignment per computation; block/stride placement
+    meshes work only because they reshape the canonical order), so the
+    group runs on the canonical flat ``(_dev,)`` mesh and every device
+    switches on its own id to the (member, grid point) the strategy
+    assigned it — the reference's tag-based per-task pinning
+    (nmt/rnn_mapper.cc:28-41) compiled into one SPMD computation.
+
+    The price, paid at group entry/exit rather than silently dropping the
+    placement (the pre-round-4 normalization): operands are replicated to
+    all devices (each branch statically slices its point's block), and
+    outputs return through a per-device stacked array."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from flexflow_tpu.parallel.ring_attention import unchecked_shard_map
+
+    ops = group.members
+    op0 = ops[0]
+    axes = op0.AXIS_NAMES
+    dims = op0.pc.dims
+    sizes = dict(zip(axes, dims))
+    mesh = machine.flat_mesh()
+    N = machine.num_devices
+    assign = set_group_assignment(group, axes)
+    in_specs_per_op = op0.input_specs()
+    out_specs_per_op = op0.output_specs()
+    pspecs = op0.param_specs()
+    k_in = len(in_specs_per_op)
+
+    have_params = bool(params_by_member and params_by_member[0])
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *params_by_member) \
+        if have_params else {}
+    flat_inputs = [x for xs in inputs_by_member for x in xs]
+
+    def body(sp, *flat):
+        dev = lax.axis_index("_dev")
+        xs_by_member = [list(flat[m * k_in:(m + 1) * k_in])
+                        for m in range(len(ops))]
+
+        def branch_for(m, idx):
+            def br(_):
+                # params: member m's leaves, each sliced to the point
+                lp = {k: _point_slice(v[m], pspecs[k], sizes, idx)
+                      for k, v in sp.items()} if have_params else {}
+                xs = [_point_slice(x, s, sizes, idx)
+                      for x, s in zip(xs_by_member[m], in_specs_per_op)]
+                res, _ = ops[m].forward(lp, {}, xs, train)
+                outs = res if isinstance(res, tuple) else (res,)
+                return tuple(jnp.expand_dims(o, 0) for o in outs)
+            return br
+
+        owned = {d: branch_for(m, idx) for d, (m, _, idx) in assign.items()}
+        shapes = jax.eval_shape(next(iter(owned.values())), 0)
+
+        def zero_branch(_):
+            return tuple(jnp.zeros(s.shape, s.dtype) for s in shapes)
+
+        branches = [owned.get(d, zero_branch) for d in range(N)]
+        return lax.switch(dev, branches, 0)
+
+    n_out = len(out_specs_per_op)
+    res = unchecked_shard_map(
+        body, mesh,
+        (jax.tree.map(lambda _: P(), stacked),) + (P(),) * len(flat_inputs),
+        tuple(P("_dev") for _ in range(n_out)))(stacked, *flat_inputs)
+
+    out = []
+    for m, row in enumerate(group.device_rows):
+        vals = []
+        for r, spec in zip(res, out_specs_per_op):
+            shards = [r[d] for d in row]  # grid-linear order by contract
+            v = _assemble(shards, spec, sizes, axes, dims)
+            v = lax.with_sharding_constraint(
+                v, machine.sharding(ops[m].pc, axes, spec))
+            vals.append(v)
+        out.append(tuple(vals))
+    return out, [{} for _ in ops]
 
 
 def _run_group_homogeneous(machine, group: PlacementGroup,

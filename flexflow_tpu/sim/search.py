@@ -586,8 +586,16 @@ class StrategySearch:
             opt_abs = jax.eval_shape(self.model.init_opt_state, params_abs)
             return float(sum(leaf.size * leaf.dtype.itemsize
                              for leaf in jax.tree.leaves(opt_abs)))
-        except Exception:  # virtual machines without a live mesh, etc.
-            return total_param_bytes
+        except Exception:
+            # abstraction unavailable (e.g. virtual machines: init's param
+            # placement needs live devices) — fall back to the round-3
+            # override heuristic: the FFModel default is the momentum
+            # state (== params), an override is treated as stateless SGD
+            from flexflow_tpu.model import FFModel
+
+            if type(self.model).init_opt_state is FFModel.init_opt_state:
+                return total_param_bytes
+            return 0.0
 
     @staticmethod
     def _param_replicas(op: Op, pc: ParallelConfig) -> float:

@@ -98,13 +98,13 @@ def test_permuted_losses_match_canonical(caplog):
     np.testing.assert_allclose(losses_p, losses_c, rtol=2e-4)
 
 
-def test_conflicting_permutations_degrade_gracefully():
+def test_conflicting_permutations_stay_canonical_view():
     n = len(jax.devices())
     s = Strategy()
     s["conv1"] = ParallelConfig((1, 1, 1, n), tuple(reversed(range(n))))
     rolled = tuple(np.roll(np.arange(n), 1).tolist())
     s["conv2"] = ParallelConfig((1, 1, 1, n), rolled)
-    ff = _small_cnn(s)  # no view rebuild; normalization path
+    ff = _small_cnn(s)  # no view rebuild; each op honored via set groups
     assert [d.id for d in ff.machine.devices] == list(range(n))
     losses = _losses(ff)
     assert all(np.isfinite(losses))
@@ -240,3 +240,108 @@ def test_uneven_spatial_split_matches_dp():
 
     np.testing.assert_allclose(losses(build(s)), losses(build(Strategy())),
                                rtol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# (c) arbitrary duplicate-free device sets (round 4 — SURVEY §2.4 closed)
+
+
+def test_set_family_assignment_exact():
+    """devices=(0,3,5,6): the per-device dispatch contract assigns grid
+    point j to exactly the j-th NAMED device — the RnnMapper semantics
+    (nmt/rnn_mapper.cc:131-135) the pre-round-4 normalization dropped."""
+    from flexflow_tpu.parallel.placement import set_group_assignment
+    from flexflow_tpu.ops.base import Tensor
+    from flexflow_tpu.ops.linear import Linear
+
+    machine = MachineModel()
+    n = machine.num_devices
+    if n != 8:
+        pytest.skip("assignment assertions assume the 8-device test mesh")
+    devs = (0, 3, 5, 6)
+    op = Linear("fc", ParallelConfig((1, 4), devs), Tensor((16, 32)), 64)
+    grp = PlacementGroup(members=[op], indices=[0], slots=[0],
+                         subset_size=4, n_groups=2,
+                         device_rows=[devs])
+    assign = set_group_assignment(grp, ("c", "n"))
+    assert {d: (m, j) for d, (m, j, _) in assign.items()} == \
+        {0: (0, 0), 3: (0, 1), 5: (0, 2), 6: (0, 3)}
+    # grid (1, 4): point j has n-index j
+    assert [assign[d][2]["n"] for d in devs] == [0, 1, 2, 3]
+
+
+def test_irregular_subset_honored(caplog):
+    """An op on devices=(0,3,5,6) executes placed (a set-family group, no
+    degradation warning) and its losses match the canonical run."""
+    machine = MachineModel()
+    n = machine.num_devices
+    if n != 8:
+        pytest.skip("irregular-list construction assumes the 8-device mesh")
+    p = n // 2
+    irregular = (0, 3, 5, 6)
+    s = Strategy()
+    s["fc1"] = ParallelConfig((1, p), irregular)
+    with caplog.at_level(logging.WARNING, logger="flexflow_tpu.machine"):
+        ff = _small_cnn(s, machine)
+        sched = ff._placement_schedule(frozenset())
+        groups = [e for e in sched if isinstance(e, PlacementGroup)]
+        assert groups and groups[0].device_rows == [irregular]
+        assert groups[0].slots == [0]
+        losses_i = _losses(ff)
+    assert not [r for r in caplog.records if "normalized" in r.message]
+    losses_c = _losses(_small_cnn(Strategy()))
+    np.testing.assert_allclose(losses_i, losses_c, rtol=2e-4)
+
+
+def test_two_irregular_subsets_group_disjointly():
+    """Same-signature ops on overlapping irregular sets stay in separate
+    groups; disjoint ones share a group (concurrent device rows)."""
+    machine = MachineModel()
+    n = machine.num_devices
+    if n != 8:
+        pytest.skip("irregular-list construction assumes the 8-device mesh")
+    p = n // 2
+    a = (0, 3, 5, 6)
+    b = tuple(sorted(set(range(n)) - set(a)))
+    s = Strategy()
+    s["fc1"] = ParallelConfig((1, p), a)
+    s["fc2"] = ParallelConfig((1, p), b)
+    cfg = FFConfig(batch_size=16, input_height=16, input_width=16,
+                   learning_rate=1e-3, seed=9, strategies=s)
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((16, 16, 16, 8), name="image")
+    t = ff.conv2d("conv1", img, 16, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    x = ff.linear("fc1", t, 64, relu=True)
+    ff.linear("fc2", t, 64, relu=True)
+    ff.softmax("softmax", ff.linear("fc3", x, 64, relu=False))
+    sched = ff._placement_schedule(frozenset())
+    groups = [e for e in sched if isinstance(e, PlacementGroup)
+              and e.device_rows is not None]
+    assert groups and len(groups[0].members) == 2
+    assert groups[0].device_rows == [a, b]
+    assert all(np.isfinite(_losses(ff)))
+
+
+def test_conflicting_permutations_now_honored(caplog):
+    """Two different whole-machine permutations cannot share one machine
+    view; since round 4 each op runs on its OWN permuted placement mesh
+    (1-member set group) instead of degrading to canonical order."""
+    n = len(jax.devices())
+    s = Strategy()
+    rev = tuple(reversed(range(n)))
+    rolled = tuple(np.roll(np.arange(n), 1).tolist())
+    s["conv1"] = ParallelConfig((1, 1, 1, n), rev)
+    s["conv2"] = ParallelConfig((1, 1, 1, n), rolled)
+    with caplog.at_level(logging.WARNING, logger="flexflow_tpu.machine"):
+        ff = _small_cnn(s)
+        assert [d.id for d in ff.machine.devices] == list(range(n))
+        sched = ff._placement_schedule(frozenset())
+        rows = [e.device_rows[0] for e in sched
+                if isinstance(e, PlacementGroup)
+                and e.device_rows is not None]
+        assert rev in rows and rolled in rows
+        losses_p = _losses(ff)
+    assert not [r for r in caplog.records if "normalized" in r.message]
+    losses_c = _losses(_small_cnn(Strategy()))
+    np.testing.assert_allclose(losses_p, losses_c, rtol=2e-4)

@@ -33,20 +33,25 @@ def test_placement_slot_accepts_aligned_blocks():
     assert placement_slot(op, 8) == ("block", 3)
 
 
-def test_placement_slot_rejects_non_blocks():
-    # full machine: not a subset placement
+def test_placement_slot_families():
+    # full machine in canonical order: the normal path, not a placement
     assert placement_slot(
         _linear("a", ParallelConfig((1, 8), tuple(range(8)))), 8) is None
     # strided constant-stride set: the stride family (round 3)
     assert placement_slot(
         _linear("b", ParallelConfig((1, 4), (0, 2, 4, 6))), 8) \
         == ("stride", 0)
-    # irregular list: neither family
+    # irregular list / misaligned block: the set family (round 4 — the
+    # list is honored in its NAMED order via per-device dispatch)
     assert placement_slot(
-        _linear("b2", ParallelConfig((1, 4), (0, 2, 4, 7))), 8) is None
-    # misaligned block
+        _linear("b2", ParallelConfig((1, 4), (0, 2, 4, 7))), 8) \
+        == ("set", (0, 2, 4, 7))
     assert placement_slot(
-        _linear("c", ParallelConfig((1, 4), (2, 3, 4, 5))), 8) is None
+        _linear("c", ParallelConfig((1, 4), (2, 3, 4, 5))), 8) \
+        == ("set", (2, 3, 4, 5))
+    # duplicates stay unplaceable (normalization warning path)
+    assert placement_slot(
+        _linear("d", ParallelConfig((1, 4), (0, 0, 1, 2))), 8) is None
 
 
 def test_plan_groups_disjoint_independent_ops():
