@@ -449,12 +449,15 @@ class MachineModel:
             return NamedSharding(self.mesh_for(pc, axis_names), spec)
         if self.num_devices % n_parts != 0:
             # grid doesn't divide the machine (non-power-of-2 corner):
-            # correct-but-unsharded fallback
-            self._warn_once(
-                ("repl", pc.dims, pc.devices),
-                f"strategy grid {pc.dims} does not divide the "
-                f"{self.num_devices}-device machine; op runs fully "
-                f"replicated (1-device speed)")
+            # correct-but-unsharded fallback.  Honored set-family groups
+            # land here too for their BOUNDARY sharding (the placed
+            # execution happened inside the group) — no warning then
+            if (pc.dims, pc.devices) not in self._honored:
+                self._warn_once(
+                    ("repl", pc.dims, pc.devices),
+                    f"strategy grid {pc.dims} does not divide the "
+                    f"{self.num_devices}-device machine; op runs fully "
+                    f"replicated (1-device speed)")
             return self.replicated()
         if (pc.dims, pc.devices) not in self._honored:
             # since round 4 every duplicate-free list of a placed-capable

@@ -26,21 +26,28 @@ class Add(Op):
         self.relu = relu
         self.output = Tensor(inputs[0].shape, inputs[0].dtype, self, name)
 
-    def output_spec(self):
+    def _spec(self):
+        """Rank-adaptive spec: NHWC activations (4-D), or batch-major
+        feature tensors of any other rank — (n, c) linear features,
+        (n, t, c) sequence residuals — with batch and the minor feature
+        dim on the grid axes."""
         from jax.sharding import PartitionSpec as P
 
-        return P("n", "h", "w", "c")
+        if self.output.ndim == 4:
+            return P("n", "h", "w", "c")
+        if self.output.ndim == 1:
+            return P("n")
+        return P("n", *([None] * (self.output.ndim - 2)), "c")
+
+    def output_spec(self):
+        return self._spec()
 
     def input_specs(self, pc=None):
-        from jax.sharding import PartitionSpec as P
-
         # elementwise: any inner grid is local when both inputs share it
-        return [P("n", "h", "w", "c"), P("n", "h", "w", "c")]
+        return [self._spec(), self._spec()]
 
     def regrid_input_specs(self):
-        from jax.sharding import PartitionSpec as P
-
-        return [P("n", "h", "w", "c")] * len(self.inputs)
+        return [self._spec()] * len(self.inputs)
 
     def placement_signature(self):
         return (self.relu,)

@@ -26,25 +26,30 @@ here:
     its subset via the inner mesh axes, with shard_map's transpose
     inserting the cross-shard reductions (the reference's BWD2/updateGAS).
 
-Supported placements: each op's ``devices`` must be one aligned contiguous
-block ``[g*P, (g+1)*P)`` of the machine (P = the op's grid size), or — the
-stride family, round 3 — one constant-stride set ``{b + j*(N/P)}`` such as
-``(0,2,4,6)``, executed on exactly the named devices via a strided
-placement mesh.  Whole-machine device *permutations* are honored one level
-up: FFModel rebuilds its machine view on the permuted order
-(model.py _permuted_machine_view).  Ops are
-groupable when they declare their input partitioning (``Op.input_specs``)
-and either share shapes/hyperparameters (``Op.placement_signature`` — the
-homogeneous fast path, params stacked with their inner sharding kept) or
-are merely *grid-compatible* (same grid dims/axes, block-replicated
-params, agreeing output positions — the HETEROGENEOUS path, round-3:
-different op kinds run as different branches of one switch, params
-flattened to a padded f32 vector stacked over the group axis, outputs
-padded to a per-position union aval).  That restores the reference's
-Legion-style concurrency between *different* ops on disjoint device sets
-(embeds on one block while LSTMs run on another, nmt/rnn.cu:298-326,
-nmt/rnn_mapper.cc:28-41).  Anything else degrades to the replicated
-normalization in ``MachineModel.sharding`` with a warning.
+Supported placements: an aligned contiguous block ``[g*P, (g+1)*P)``
+(P = the op's grid size); a constant-stride set ``{b + j*(N/P)}`` such as
+``(0,2,4,6)`` (stride family, round 3); or — round 4, closing SURVEY
+§2.4 — ANY other duplicate-free list (``(0,3,5,6)``, misaligned blocks,
+conflicting whole-machine permutations), honored in its named order by
+set-family per-device dispatch.  A single whole-machine *permutation* is
+honored one level up: FFModel rebuilds its machine view on the permuted
+order (model.py _permuted_machine_view).  Ops are groupable when they
+declare their input partitioning (``Op.input_specs``) and either share
+shapes/hyperparameters (``Op.placement_signature`` — the homogeneous
+fast path, params stacked with their inner sharding kept) or join the
+HETEROGENEOUS path: different op kinds as different branches of one
+switch, params (and, round 4, state) flattened to padded f32 vectors
+stacked over the group axis.  Round 4 generalizes hetero membership
+beyond "same grid, agreeing outputs": the mesh is built on one OWNER
+grid, members of any other grid shape (same subset size) join as
+point-local guests with their specs rewritten through an axis
+translation (a conv(2,2,1,.) hosts an LSTM(4,) guest), and members with
+incompatible output avals occupy disjoint switch positions instead of
+being refused.  That restores the reference's Legion-style concurrency
+between *different* ops on disjoint device sets (embeds on one block
+while LSTMs run on another, nmt/rnn.cu:298-326, nmt/rnn_mapper.cc:28-41).
+Only duplicate device lists and ops without placed support degrade to
+the replicated normalization in ``MachineModel.sharding`` with a warning.
 """
 
 from __future__ import annotations
@@ -72,6 +77,11 @@ class PlacementGroup:
     #: set family: row g of the placement mesh is exactly device_rows[g]
     #: (member order; the machine pads remaining devices as zero rows)
     device_rows: Optional[List[Tuple[int, ...]]] = None
+    #: hetero owner grid: the mesh is built on these dims/axes; members
+    #: with any other grid run as point-local guests with translated
+    #: specs (round 4 — None means the first member's grid)
+    owner_dims: Optional[Tuple[int, ...]] = None
+    owner_axes: Optional[Tuple[str, ...]] = None
 
 
 def placement_slot(op: Op, num_devices: int):
@@ -87,7 +97,7 @@ def placement_slot(op: Op, num_devices: int):
     not divide the machine) — those normalize with a warning."""
     pc = op.pc
     p = pc.num_parts
-    if num_devices <= 1 or p > num_devices or num_devices % p:
+    if num_devices <= 1 or p > num_devices:
         return None
     if op.placement_signature() is None or op.input_specs() is None:
         return None
@@ -95,6 +105,11 @@ def placement_slot(op: Op, num_devices: int):
         return None  # stateful op without placed-state support
     if len(set(pc.devices)) != p:
         return None
+    if num_devices % p:
+        # block/stride tilings need P | N; set-family per-device dispatch
+        # does not (its flat mesh just leaves more devices on the zero
+        # branch), so e.g. a (1,3) grid on (0,3,5) of 8 is still honored
+        return ("set", tuple(pc.devices)) if _set_eligible(op) else None
     if p == num_devices:
         # full-machine lists: canonical order is the normal (free) path;
         # a single foreign permutation is absorbed by the machine-view
@@ -128,17 +143,40 @@ def _set_eligible(op: Op) -> bool:
     spec entry a single axis name or None (the slicer's vocabulary)."""
     if not op.placed_local() or op.init_state():
         return False
+    sizes = dict(zip(op.AXIS_NAMES, op.pc.dims))
 
-    def ok(spec):
-        return spec is not None and all(
-            e is None or isinstance(e, str) for e in tuple(spec))
+    def ok(spec, shape):
+        # single-axis entries only, and every sharded dim must divide
+        # evenly (the per-point slicer floor-divides; a ragged dim would
+        # silently truncate)
+        if spec is None:
+            return False
+        for d, e in enumerate(tuple(spec)):
+            if e is None:
+                continue
+            if not isinstance(e, str):
+                return False
+            parts = sizes.get(e, 1)
+            if parts > 1 and (d >= len(shape) or shape[d] % parts):
+                return False
+        return True
 
     outs = op.output_specs()
-    if outs is None or not all(ok(s) for s in outs):
+    if outs is None or not all(
+            ok(s, t.shape) for s, t in zip(outs, op.all_outputs())):
         return False
-    if not all(ok(s) for s in op.input_specs()):
+    if not all(ok(s, t.shape)
+               for s, t in zip(op.input_specs(), op.inputs)):
         return False
-    return all(ok(s) for s in op.param_specs().values())
+    params = op.param_specs()
+    if params:
+        import jax
+
+        shapes = jax.eval_shape(lambda: op.init_params(
+            jax.random.PRNGKey(0)))
+        if not all(ok(params[k], shapes[k].shape) for k in params):
+            return False
+    return True
 
 
 def _signature(op: Op) -> tuple:
@@ -167,31 +205,32 @@ def _params_block_replicated(op: Op) -> bool:
     return True
 
 
-def _out_positions(op: Op):
-    """Per output position: (spec entries, rank, sharded-dim extents,
-    dtype) — the compatibility record heterogeneous grouping checks so
-    every member's position-k output can share one switch aval and one
-    out_spec."""
+def _state_block_replicated(op: Op) -> bool:
+    """True when ``op``'s state rides the hetero f32 group vector without
+    losing sharding or precision: state_specs exist, every entry is
+    replicated within the block, and leaves are f32-family."""
+    specs = op.state_specs()
+    if specs is None:
+        return False
     sizes = dict(zip(op.AXIS_NAMES, op.pc.dims))
-    out = []
-    for t, spec in zip(op.all_outputs(), op.output_specs()):
-        entries = tuple(spec) if spec is not None else None
-        sharded = []
-        if entries is not None:
-            for d, e in enumerate(entries):
-                if e is None:
-                    continue
-                names = e if isinstance(e, tuple) else (e,)
-                if any(sizes.get(a, 1) > 1 for a in names):
-                    sharded.append((d, t.shape[d]))
-        out.append((entries, t.ndim, tuple(sharded), t.dtype))
-    return tuple(out)
+    for spec in specs.values():
+        for entry in spec:
+            if entry is None:
+                continue
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                if sizes.get(a, 1) != 1:
+                    return False
+    return all(str(l.dtype) in ("float32", "bfloat16", "float16")
+               for l in op.init_state().values())
 
 
 def _hetero_eligible(op: Op) -> bool:
-    """Can ``op`` join a heterogeneous (mixed-kind) placement group?"""
-    if op.init_state():
-        return False  # state threading is homogeneous-path only
+    """Can ``op`` join a heterogeneous (mixed-kind) placement group?
+    Round 4 lifts the round-3 stateless restriction: stateful members
+    (e.g. BatchNorm) thread their state through a second group-stacked
+    f32 vector, provided it is block-replicated."""
+    if op.init_state() and not _state_block_replicated(op):
+        return False
     if not _params_block_replicated(op):
         return False
     if op.output_specs() is None or any(s is None
@@ -200,8 +239,97 @@ def _hetero_eligible(op: Op) -> bool:
     return all(t.dtype != "int32" for t in op.all_outputs())
 
 
+def _axis_translation(op: Op, owner_dims, owner_axes):
+    """Map each of ``op``'s grid axes to owner mesh axes such that the
+    two linearizations (dim 0 fastest) coincide: every nontrivial guest
+    dim must equal a product of CONSECUTIVE nontrivial owner dims.
+    Returns {guest axis: tuple of owner axes, slowest-first (the
+    PartitionSpec multi-axis convention)} or None if not expressible.
+    Identity grids translate to themselves."""
+    o = [(a, d) for a, d in zip(owner_axes, owner_dims) if d > 1]
+    i = 0
+    mapping = {}
+    for ga, gd in zip(op.AXIS_NAMES, op.pc.dims):
+        if gd == 1:
+            continue
+        prod, take = 1, []
+        while prod < gd and i < len(o):
+            take.append(o[i][0])
+            prod *= o[i][1]
+            i += 1
+        if prod != gd:
+            return None
+        mapping[ga] = tuple(reversed(take))
+    return mapping if i == len(o) else None
+
+
+def _translate_spec(spec, mapping):
+    """Rewrite a single-axis-entry PartitionSpec onto owner mesh axes."""
+    from jax.sharding import PartitionSpec as P
+
+    entries = []
+    for e in tuple(spec):
+        if e is None:
+            entries.append(None)
+            continue
+        if not isinstance(e, str):
+            return None  # multi-axis guest entries unsupported
+        t = mapping.get(e, ())
+        entries.append(None if len(t) == 0 else
+                       (t[0] if len(t) == 1 else t))
+    return P(*entries)
+
+
+def _member_view(op: Op, owner_dims, owner_axes):
+    """(native, mapping, in_specs, out_specs) of ``op`` on the owner
+    mesh, or None when the member cannot run there.  Native members
+    (exact same grid dims AND axis names) keep their specs and may be
+    grid-aware (their placed hooks see the live owner axes); any other
+    grid joins as a point-local GUEST with its specs rewritten through
+    the axis translation."""
+    native = (op.pc.dims == tuple(owner_dims)
+              and op.AXIS_NAMES == tuple(owner_axes))
+    if native:
+        return True, None, list(op.input_specs()), list(op.output_specs())
+    if not op.placed_local() or op.init_state():
+        return None
+    mapping = _axis_translation(op, owner_dims, owner_axes)
+    if mapping is None:
+        return None
+    ins = [_translate_spec(s, mapping) for s in op.input_specs()]
+    outs = [_translate_spec(s, mapping) for s in op.output_specs()]
+    if any(s is None for s in ins) or any(s is None for s in outs):
+        return None
+    return False, mapping, ins, outs
+
+
+def _out_positions_on(op: Op, out_specs, owner_sizes):
+    """Per output position (live spec entries, rank, sharded-dim extents,
+    dtype) — computed against owner-mesh specs so members of different
+    grids compare in one vocabulary.  Entries naming only size-1 owner
+    axes normalize to None, so a native spec like P("n","h","w","c") on
+    a batch-only grid matches a guest's translated P("n",None,None,None)."""
+    def live(e):
+        names = e if isinstance(e, tuple) else (e,)
+        return any(owner_sizes.get(a, 1) > 1 for a in names)
+
+    out = []
+    for t, spec in zip(op.all_outputs(), out_specs):
+        raw = tuple(spec) if spec is not None else None
+        entries = None
+        sharded = []
+        if raw is not None:
+            entries = tuple(e if (e is not None and live(e)) else None
+                            for e in raw)
+            for d, e in enumerate(entries):
+                if e is not None:
+                    sharded.append((d, t.shape[d]))
+        out.append((entries, t.ndim, tuple(sharded), t.dtype))
+    return tuple(out)
+
+
 def _hetero_compatible(a, b) -> bool:
-    """Output-position compatibility of two _out_positions records: shared
+    """Output-position compatibility of two position records: shared
     positions must agree on spec, rank and sharded-dim extents (unsharded
     dims are zero-padded to the union; sharded dims cannot be)."""
     for pa, pb in zip(a, b):
@@ -256,14 +384,20 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
             return any(gs & set(s) for s in slots)
         return g in slots
 
-    def join(grp, i, g, elig, pos):
+    def join(grp, i, g, elig):
         grp["indices"].append(i)
         grp["slots"].append(g)
         grp["hetero_ok"] = grp["hetero_ok"] and elig
-        if pos is not None and grp["pos"] is not None \
-                and len(pos) > len(grp["pos"]):
-            grp["pos"] = pos
         group_of[i] = grp["id"]
+
+    def group_fits(member_ids, owner_dims, owner_axes):
+        """Every member of ``member_ids`` can run on the owner grid
+        (native, or as a translated point-local guest).  Output-aval
+        compatibility is NOT required: incompatible members occupy
+        disjoint output positions of the switch (round 4 — a 4-D spatial
+        conv and a 2-D batch linear share one group)."""
+        return all(_member_view(layers[j], owner_dims, owner_axes)
+                   is not None for j in member_ids)
 
     for i, op in enumerate(layers):
         if i in exclude:
@@ -276,37 +410,51 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         # set-family groups are homogeneous-only: their per-device switch
         # slices operands by ONE shared spec set
         elig = fam != "set" and _hetero_eligible(op)
-        pos = _out_positions(op) if elig else None
         placed = False
         for grp in open_by_sig.get(sig, []):
             if grp["family"] != fam or conflicts(fam, g, grp["slots"]):
                 continue
             if any(m in anc[i] for m in grp["indices"]):
                 continue  # dependency path member -> op
-            join(grp, i, g, elig, pos)
+            if grp["mixed"] and not group_fits(
+                    grp["indices"] + [i],
+                    grp["owner_dims"], grp["owner_axes"]):
+                # hetero members arrived since and the candidate does not
+                # fit the (possibly switched) owner grid
+                continue
+            join(grp, i, g, elig)
             placed = True
             break
         if not placed and elig:
-            for grp in open_by_grid.get(
-                    (op.pc.dims, op.AXIS_NAMES, fam), []):
+            for grp in open_by_grid.get((op.pc.num_parts, fam), []):
                 if not grp["hetero_ok"] or conflicts(fam, g, grp["slots"]):
                     continue
                 if any(m in anc[i] for m in grp["indices"]):
                     continue
-                if not _hetero_compatible(grp["pos"], pos):
-                    continue
-                join(grp, i, g, elig, pos)
+                # candidate on the group's current owner grid ...
+                owner = (grp["owner_dims"], grp["owner_axes"])
+                if not group_fits(grp["indices"] + [i], *owner):
+                    # ... or the candidate's grid becomes the owner (it
+                    # may refine the current one, e.g. a spatial conv
+                    # joining batch-grid guests — round 4)
+                    owner = (op.pc.dims, op.AXIS_NAMES)
+                    if not group_fits(grp["indices"] + [i], *owner):
+                        continue
+                grp["owner_dims"], grp["owner_axes"] = owner
+                join(grp, i, g, elig)
+                grp["mixed"] = True
                 placed = True
                 break
         if not placed:
             grp = {"id": len(groups), "indices": [i], "slots": [g],
                    "subset": op.pc.num_parts, "hetero_ok": elig,
-                   "pos": pos, "family": fam}
+                   "family": fam, "mixed": False,
+                   "owner_dims": op.pc.dims, "owner_axes": op.AXIS_NAMES}
             groups.append(grp)
             open_by_sig.setdefault(sig, []).append(grp)
             if elig:
                 open_by_grid.setdefault(
-                    (op.pc.dims, op.AXIS_NAMES, fam), []).append(grp)
+                    (op.pc.num_parts, fam), []).append(grp)
             group_of[i] = grp["id"]
 
     # ---- merge into schedule nodes + topological order ----
@@ -371,7 +519,9 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
                     subset_size=grp["subset"],
                     n_groups=num_devices // grp["subset"],
                     strided=grp["family"] == "stride",
-                    device_rows=(list(grp["slots"]) if is_set else None)))
+                    device_rows=(list(grp["slots"]) if is_set else None),
+                    owner_dims=grp["owner_dims"],
+                    owner_axes=grp["owner_axes"]))
             for s in nsucc[nid]:
                 indeg[s] -= 1
                 if indeg[s] == 0:
@@ -391,7 +541,10 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         grp = {"id": len(groups), "indices": [last],
                "slots": [slot_last],
                "subset": layers[last].pc.num_parts,
-               "hetero_ok": False, "pos": None, "family": fam_last}
+               "hetero_ok": False, "family": fam_last,
+               "mixed": False,
+               "owner_dims": layers[last].pc.dims,
+               "owner_axes": layers[last].AXIS_NAMES}
         groups.append(grp)
         group_of[last] = grp["id"]
 
@@ -414,7 +567,8 @@ def run_group(machine, group: PlacementGroup,
                               inputs_by_member, train)
     if len({_signature(op) for op in group.members}) > 1:
         return _run_group_hetero(machine, group, params_by_member,
-                                 inputs_by_member, train)
+                                 inputs_by_member, train,
+                                 states_by_member)
     return _run_group_homogeneous(machine, group, params_by_member,
                                   inputs_by_member, train,
                                   states_by_member)
@@ -682,8 +836,10 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
 
 def _run_group_hetero(machine, group: PlacementGroup,
                       params_by_member: List[Dict],
-                      inputs_by_member: List[List], train: bool):
-    """Mixed-kind members (round-3): each member is its own switch branch.
+                      inputs_by_member: List[List], train: bool,
+                      states_by_member: Optional[List[Dict]] = None):
+    """Mixed-kind members (round 3; generalized round 4): each member is
+    its own switch branch.
 
     lax.switch requires every branch to return identical avals, and the
     members' param trees don't mirror, so:
@@ -695,12 +851,27 @@ def _run_group_hetero(machine, group: PlacementGroup,
         slice back to shapes/dtypes).  Grouping admits only members whose
         params are replicated within their block
         (:func:`_params_block_replicated`), so no inner sharding is lost.
-      * inputs: per-member ``input_specs`` (counts and ranks may differ) —
-        the flat argument list concatenates every member's inputs.
+      * state (round 4, lifting the stateless restriction): threaded the
+        same way through a SECOND group-stacked f32 vector; the branch
+        unflattens, runs, and re-ravels its new state, which returns as
+        an extra output position (``_state_block_replicated`` gates
+        eligibility, so no inner sharding is lost here either).
+      * grids (round 4): the mesh is built on the group's OWNER grid
+        (``group.owner_dims/axes``); members with the exact owner grid
+        are native and may be grid-aware (their placed hooks see the
+        live axes — e.g. a spatial conv's halo ppermutes), while any
+        other grid of the same subset size joins as a point-local GUEST
+        whose specs are rewritten through :func:`_axis_translation`
+        (its single batch axis becomes a tuple of owner axes) — a
+        conv(2,2,1,.) and an LSTM(4,) now share one switch.
+      * inputs: per-member translated ``input_specs`` (counts and ranks
+        may differ) — the flat argument list concatenates every member's
+        inputs.
       * outputs: padded to the per-position union aval (grouping
         guaranteed shared positions agree on spec/rank/sharded extents —
-        only unsharded dims pad); missing positions are zeros.  The caller
-        crops each member's outputs back to its true shapes/dtypes.
+        only unsharded dims pad); missing positions are zeros.  The
+        caller crops each member's outputs back to its true
+        shapes/dtypes.
 
     This is the reference's operator parallelism: different Legion tasks
     on disjoint GPU sets executing concurrently (nmt/rnn.cu:298-326),
@@ -718,45 +889,64 @@ def _run_group_hetero(machine, group: PlacementGroup,
     ops = group.members
     op0 = ops[0]
     G = group.n_groups
-    mesh = machine.placement_mesh(op0.pc.dims, op0.AXIS_NAMES,
+    owner_dims = group.owner_dims or op0.pc.dims
+    owner_axes = group.owner_axes or op0.AXIS_NAMES
+    mesh = machine.placement_mesh(owner_dims, owner_axes,
                                   strided=group.strided)
     slots = group.slots
+    if states_by_member is None:
+        states_by_member = [{} for _ in ops]
+    views = [_member_view(m, owner_dims, owner_axes) for m in ops]
+    assert all(v is not None for v in views), \
+        "grouping admitted a member the owner grid cannot host"
 
-    # ---- params: flatten -> f32 ravel -> pad -> stack over _pg ----
-    metas = []   # per member: (treedef, [(shape, dtype)])
-    vecs = []
-    for m, p in zip(ops, params_by_member):
-        leaves, treedef = jax.tree.flatten(p)
+    def ravel_tree(tree, what, name):
+        leaves, treedef = jax.tree.flatten(tree)
         for l in leaves:
             # the vector rides through f32: exact for f32/bf16/f16 leaves,
             # lossy for anything else — fail loudly rather than corrupt
             if str(l.dtype) not in ("float32", "bfloat16", "float16"):
                 raise TypeError(
-                    f"heterogeneous placement of {m.name!r}: param dtype "
+                    f"heterogeneous placement of {name!r}: {what} dtype "
                     f"{l.dtype} does not round-trip through the f32 "
                     f"group vector")
-        metas.append((treedef,
-                      [(l.shape, str(l.dtype)) for l in leaves]))
-        vecs.append(
-            jnp.concatenate([l.ravel().astype(jnp.float32)
-                             for l in leaves])
-            if leaves else jnp.zeros((0,), jnp.float32))
-    lmax = max((v.shape[0] for v in vecs), default=0)
-    by_slot = {g: jnp.pad(v, (0, lmax - v.shape[0]))
-               for g, v in zip(slots, vecs)}
-    zero_vec = jnp.zeros((lmax,), jnp.float32)
-    stacked = jnp.stack([by_slot.get(g, zero_vec) for g in range(G)])
+        vec = jnp.concatenate([l.ravel().astype(jnp.float32)
+                               for l in leaves]) \
+            if leaves else jnp.zeros((0,), jnp.float32)
+        return vec, (treedef, [(l.shape, str(l.dtype)) for l in leaves])
 
-    member_in_specs = [m.input_specs() for m in ops]
-    in_specs = (P("_pg", None),) + tuple(s for specs in member_in_specs
-                                         for s in specs)
+    def stack_vecs(vecs):
+        lmax = max((v.shape[0] for v in vecs), default=0)
+        by_slot = {g: jnp.pad(v, (0, lmax - v.shape[0]))
+                   for g, v in zip(slots, vecs)}
+        zero = jnp.zeros((lmax,), jnp.float32)
+        return jnp.stack([by_slot.get(g, zero) for g in range(G)]), lmax
+
+    # ---- params and state: flatten -> f32 ravel -> pad -> stack ----
+    pvecs, metas = [], []
+    for m, p in zip(ops, params_by_member):
+        v, meta = ravel_tree(p, "param", m.name)
+        pvecs.append(v)
+        metas.append(meta)
+    stacked, _ = stack_vecs(pvecs)
+    svecs, smetas = [], []
+    for m, st in zip(ops, states_by_member):
+        v, meta = ravel_tree(st, "state", m.name)
+        svecs.append(v)
+        smetas.append(meta)
+    stacked_state, smax = stack_vecs(svecs)
+
+    member_in_specs = [v[2] for v in views]
+    in_specs = (P("_pg", None), P("_pg", None)) + tuple(
+        s for specs in member_in_specs for s in specs)
     flat_inputs = [x for xs in inputs_by_member for x in xs]
     # the members' REAL global output avals (declared Tensor dtypes can be
     # stale under compute-dtype propagation): crop/cast targets
     real_avals = []
     for m in range(len(ops)):
         def fwd(m=m):
-            res, _ = ops[m].forward(params_by_member[m], {},
+            res, _ = ops[m].forward(params_by_member[m],
+                                    states_by_member[m],
                                     inputs_by_member[m], train)
             return res if isinstance(res, tuple) else (res,)
         real_avals.append(jax.eval_shape(fwd))
@@ -764,84 +954,133 @@ def _run_group_hetero(machine, group: PlacementGroup,
     for specs in member_in_specs:
         offs.append(offs[-1] + len(specs))
 
-    # out_specs from the first member carrying each position
-    pos_spec = {}
-    for m in ops:
-        for k, spec in enumerate(m.output_specs()):
-            pos_spec.setdefault(k, spec)
-    n_pos = len(pos_spec)
+    # Output positions: members CLUSTER by output-aval compatibility
+    # (same translated specs / rank / sharded extents per position);
+    # each cluster owns a disjoint contiguous range of switch positions,
+    # so members with unrelated outputs — a 4-D spatial conv beside a
+    # 2-D batch linear — still share one switch (round 4; previously a
+    # grouping-time gate).  Within a cluster, unsharded dims pad to the
+    # union aval as before.
+    sizes = dict(zip(owner_axes, owner_dims))
+    records = [_out_positions_on(m, v[3], sizes)
+               for m, v in zip(ops, views)]
+    clusters = []      # {"members": [i..], "record": union, "specs": []}
+    cluster_of = []
+    for i, rec in enumerate(records):
+        for ci, cl in enumerate(clusters):
+            if _hetero_compatible(cl["record"], rec):
+                cl["members"].append(i)
+                if len(rec) > len(cl["record"]):
+                    cl["record"] = rec
+                for k, spec in enumerate(views[i][3]):
+                    if k >= len(cl["specs"]):
+                        cl["specs"].append(spec)
+                cluster_of.append(ci)
+                break
+        else:
+            clusters.append({"members": [i], "record": rec,
+                             "specs": list(views[i][3])})
+            cluster_of.append(len(clusters) - 1)
+    pos_off = [0]
+    for cl in clusters:
+        pos_off.append(pos_off[-1] + len(cl["record"]))
+    n_pos = pos_off[-1]
+    pos_spec = []
+    for cl in clusters:
+        pos_spec.extend(cl["specs"])
+    assert len(pos_spec) == n_pos
 
-    def body(sp, *flat):
+    def unravel(vec, meta):
+        treedef, leaf_meta = meta
+        leaves, off = [], 0
+        for shape, dtype in leaf_meta:
+            size = int(_math.prod(shape))
+            leaves.append(vec[off:off + size].reshape(shape).astype(dtype))
+            off += size
+        return jax.tree.unflatten(treedef, leaves)
+
+    def body(sp, st, *flat):
         local_vec = sp[0]
+        local_svec = st[0]
         gidx = lax.axis_index("_pg")
         # collective preludes run for every member unconditionally (same
         # rationale as the homogeneous path: member inputs are replicated
-        # over the group axis; collectives inside branches are illegal)
+        # over the group axis; collectives inside branches are illegal).
+        # Guests are point-local by construction, so their preludes are
+        # no-ops
         aux_by_member = [
             ops[m].placed_prelude(list(flat[offs[m]:offs[m + 1]]), train)
             for m in range(len(ops))]
 
         def raw_branch(m):
             def br(_):
-                treedef, leaf_meta = metas[m]
-                leaves = []
-                off = 0
-                for shape, dtype in leaf_meta:
-                    size = int(_math.prod(shape))
-                    leaves.append(local_vec[off:off + size]
-                                  .reshape(shape).astype(dtype))
-                    off += size
-                p = jax.tree.unflatten(treedef, leaves)
-                res, _st = ops[m].sharded_forward(
-                    p, {}, list(flat[offs[m]:offs[m + 1]]), train,
+                p = unravel(local_vec, metas[m])
+                s = unravel(local_svec, smetas[m])
+                res, new_st = ops[m].sharded_forward(
+                    p, s, list(flat[offs[m]:offs[m + 1]]), train,
                     aux=aux_by_member[m])
-                return res if isinstance(res, tuple) else (res,)
+                outs = res if isinstance(res, tuple) else (res,)
+                nsv, _ = ravel_tree(new_st, "state", ops[m].name)
+                nsv = jnp.pad(nsv, (0, smax - nsv.shape[0]))
+                return outs, nsv
             return br
 
-        shapes_by_m = [jax.eval_shape(raw_branch(m), 0)
-                       for m in range(len(ops))]
-        union = []
-        for k in range(n_pos):
-            cands = [s[k] for s in shapes_by_m if len(s) > k]
-            shape = tuple(max(c.shape[d] for c in cands)
-                          for d in range(cands[0].ndim))
-            union.append((shape, jnp.result_type(*[c.dtype
-                                                   for c in cands])))
+        shapes_by_m = [jax.eval_shape(lambda x, m=m: raw_branch(m)(x)[0],
+                                      0) for m in range(len(ops))]
+        # per-cluster union avals laid out over the global position range
+        union = [None] * n_pos
+        for ci, cl in enumerate(clusters):
+            for k in range(len(cl["record"])):
+                cands = [shapes_by_m[i][k] for i in cl["members"]
+                         if len(shapes_by_m[i]) > k]
+                shape = tuple(max(c.shape[d] for c in cands)
+                              for d in range(cands[0].ndim))
+                union[pos_off[ci] + k] = (
+                    shape, jnp.result_type(*[c.dtype for c in cands]))
 
         def padded_branch(m):
+            ci = cluster_of[m]
+
             def br(_):
-                outs = raw_branch(m)(0)
+                outs, nsv = raw_branch(m)(0)
                 padded = []
                 for k, (shape, dtype) in enumerate(union):
-                    if k < len(outs):
-                        o = outs[k].astype(dtype)
+                    j = k - pos_off[ci]
+                    if 0 <= j < len(outs):
+                        o = outs[j].astype(dtype)
                         o = jnp.pad(o, [(0, shape[d] - o.shape[d])
                                         for d in range(o.ndim)])
                     else:
                         o = jnp.zeros(shape, dtype)
                     padded.append(jnp.expand_dims(o, 0))
-                return tuple(padded)
+                return tuple(padded) + (jnp.expand_dims(nsv, 0),)
             return br
 
         owned = {g: padded_branch(m) for m, g in enumerate(slots)}
 
         def zero_branch(_):
-            return tuple(jnp.zeros((1,) + s, d) for s, d in union)
+            return tuple(jnp.zeros((1,) + s, d) for s, d in union) + \
+                (jnp.zeros((1, smax), jnp.float32),)
 
         return lax.switch(gidx, [owned.get(g, zero_branch)
                                  for g in range(G)], 0)
 
-    out_specs = tuple(P("_pg", *pos_spec[k]) for k in range(n_pos))
+    out_specs = tuple(P("_pg", *spec) for spec in pos_spec) + \
+        (P("_pg", None),)
     res = unchecked_shard_map(body, mesh, in_specs, out_specs)(
-        stacked, *flat_inputs)
+        stacked, stacked_state, *flat_inputs)
+    new_svecs = res[n_pos]
+    res = res[:n_pos]
     # crop each member's outputs back to its true global shapes/dtypes,
     # with the same anti-remat sharding waypoint as the homogeneous path
     out = []
+    new_states = []
     for i, (g, m) in enumerate(zip(slots, ops)):
+        base = pos_off[cluster_of[i]]
         vals = []
         for k, spec in enumerate(m.output_specs()):
             av = real_avals[i][k]
-            v = res[k][g]
+            v = res[base + k][g]
             if v.shape != av.shape:
                 v = lax.slice(v, (0,) * av.ndim, av.shape)
             v = v.astype(av.dtype)
@@ -850,4 +1089,6 @@ def _run_group_hetero(machine, group: PlacementGroup,
                     v, machine.sharding(m.pc, m.AXIS_NAMES, spec))
             vals.append(v)
         out.append(tuple(vals))
-    return out, [{} for _ in ops]  # hetero members are stateless
+        new_states.append(unravel(new_svecs[g], smetas[i])
+                          if states_by_member[i] else {})
+    return out, new_states

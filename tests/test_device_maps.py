@@ -345,3 +345,45 @@ def test_conflicting_permutations_now_honored(caplog):
     assert not [r for r in caplog.records if "normalized" in r.message]
     losses_c = _losses(_small_cnn(Strategy()))
     np.testing.assert_allclose(losses_p, losses_c, rtol=2e-4)
+
+
+def test_non_dividing_subset_honored():
+    """A grid whose size does not divide the machine (p=3 on 8 devices)
+    still executes placed under the set family — per-device dispatch
+    needs no tiling, just more zero branches."""
+    machine = MachineModel()
+    n = machine.num_devices
+    if n != 8:
+        pytest.skip("device list assumes the 8-device test mesh")
+    import logging
+
+    s = Strategy()
+    s["fc1"] = ParallelConfig((1, 3), (0, 3, 5))
+    # 64 output channels and batch 16 divide nothing by 3 — shard the
+    # batch? no: (1, 3) splits batch 16 by 3 unevenly, so use a (3, 1)
+    # channel split of a 48-wide linear instead
+    s2 = Strategy()
+    s2["fc1"] = ParallelConfig((3, 1), (0, 3, 5))
+
+    def build(strategies, width):
+        cfg = FFConfig(batch_size=16, input_height=16, input_width=16,
+                       learning_rate=1e-3, seed=9, strategies=strategies)
+        ff = FFModel(cfg, machine)
+        img = ff.create_input((16, 16, 16, 8), name="image")
+        t = ff.conv2d("conv1", img, 16, 3, 3, 1, 1, 1, 1, relu=True)
+        t = ff.flat("flat", t)
+        t = ff.linear("fc1", t, width, relu=True)
+        ff.softmax("softmax", t)
+        return ff
+
+    with_cap = logging.getLogger("flexflow_tpu.machine")
+    import numpy as np
+
+    ff = build(s2, 48)
+    sched = ff._placement_schedule(frozenset())
+    groups = [e for e in sched if isinstance(e, PlacementGroup)
+              and e.device_rows is not None]
+    assert groups and groups[0].device_rows == [(0, 3, 5)]
+    losses = _losses(ff)
+    want = _losses(build(Strategy(), 48))
+    np.testing.assert_allclose(losses, want, rtol=2e-4)
