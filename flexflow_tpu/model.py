@@ -274,7 +274,30 @@ class FFModel:
                         # PARAMETER_ALL_ONES parity (conv_2d.cu:393-398):
                         # deterministic all-ones weights, hand-checkable runs
                         p = {k: jnp.ones_like(v) for k, v in p.items()}
-                if p:
+                bp = getattr(self, "_block_params", {}).get(op.param_key)
+                if p and bp:
+                    # block-resident storage (see _derive_block_params):
+                    # stacked (G, ...) with the op's row live, sharded
+                    # over the placement mesh so each block holds only
+                    # its own member's weights
+                    G, slot = bp["G"], bp["slot"]
+                    sh = self._block_sharding(bp)
+                    if abstract:
+                        params[op.param_key] = {
+                            k: jax.ShapeDtypeStruct(
+                                (G,) + tuple(v.shape), v.dtype,
+                                sharding=sh[k])
+                            for k, v in p.items()
+                        }
+                    else:
+                        params[op.param_key] = {
+                            k: jax.device_put(
+                                jnp.zeros((G,) + tuple(v.shape),
+                                          v.dtype).at[slot].set(v),
+                                sh[k])
+                            for k, v in p.items()
+                        }
+                elif p:
                     with self._honored_ctx():
                         shardings = op.param_shardings(self.machine)
                     if abstract:
@@ -311,9 +334,17 @@ class FFModel:
         """{param_key: {name: sharding}} mirroring ``params`` — the same
         shardings init() placed them with."""
         shardings = {}
+        block = getattr(self, "_block_params", {})
         with self._honored_ctx():
             for op in self.layers:
                 if op.param_key in params and op.param_key not in shardings:
+                    bp = block.get(op.param_key)
+                    if bp:
+                        sh = self._block_sharding(bp)
+                        shardings[op.param_key] = {
+                            k: sh[k] for k in params[op.param_key]
+                        }
+                        continue
                     sh = op.param_shardings(self.machine)
                     shardings[op.param_key] = {
                         k: sh[k] for k in params[op.param_key]
@@ -489,7 +520,76 @@ class FFModel:
                 pcs.extend(m.pc for m in entry.members)
         self._honored_pcs = pcs
         self._sched_cache = (exclude, sched)
+        if exclude == frozenset() and not hasattr(self, "_block_params"):
+            self._block_params = self._derive_block_params(sched)
         return sched
+
+    def _derive_block_params(self, sched):
+        """param_key -> {slot, dims, axes, strided, G} for params stored
+        BLOCK-RESIDENT: stacked (G, ...) and sharded over the placement
+        mesh's group axis, so a placed op's weights (and their gradients
+        and optimizer state) physically live only on its device block.
+        Without this the params enter the jit on the normalized canonical
+        sharding and run_group re-stacks them ACROSS the group axis every
+        step — on a two-tier machine that moves the full FC parameter
+        footprint over DCN each iteration, erasing exactly the win the
+        searched strategies claim (found by the round-4 compiled-HLO
+        collective audit, tests/test_two_tier.py; the reference keeps
+        non-shared weights on their op's GPUs, linear.cu:95-124).
+
+        Eligible: members of HOMOGENEOUS block/stride groups whose
+        param_key is used by exactly ONE layer (shared keys — the NMT
+        SharedVariable pattern — may appear in several groups at
+        different slots, which one stacked copy cannot serve) and is not
+        a fused-LM-head candidate (that path consumes raw leaves)."""
+        from flexflow_tpu.ops.rnn_linear import RnnLinear
+        from flexflow_tpu.parallel.placement import (PlacementGroup,
+                                                     _signature)
+
+        uses: Dict[str, int] = {}
+        for op in self.layers:
+            uses[op.param_key] = uses.get(op.param_key, 0) + 1
+        out = {}
+        for entry in sched:
+            if not isinstance(entry, PlacementGroup):
+                continue
+            if entry.device_rows is not None:
+                continue  # set family replicates operands by design
+            if len({_signature(m) for m in entry.members}) > 1:
+                continue  # hetero path ravels params into group vectors
+            for m, g in zip(entry.members, entry.slots):
+                if (uses.get(m.param_key) == 1 and m.param_specs()
+                        and not isinstance(m, RnnLinear)):
+                    out[m.param_key] = {
+                        "slot": g, "dims": m.pc.dims,
+                        "axes": m.AXIS_NAMES, "strided": entry.strided,
+                        "G": entry.n_groups,
+                        "specs": m.param_specs()}
+        return out
+
+    def _block_sharding(self, bp):
+        """{param name: NamedSharding} of one block-resident registry
+        entry — the single source of truth for the stacked (G, ...)
+        layout used by init() and _param_shardings()."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.machine.placement_mesh(bp["dims"], bp["axes"],
+                                           strided=bp["strided"])
+        return {k: NamedSharding(mesh, P("_pg", *spec))
+                for k, spec in bp["specs"].items()}
+
+    def _member_params(self, params, op):
+        """The op's param tree as ITS code expects it — block-resident
+        keys are stored stacked (G, ...), so unplaced execution paths
+        (single-op schedule entries, dump mode) slice the op's row."""
+        p = params.get(op.param_key, {})
+        bp = getattr(self, "_block_params", {}).get(op.param_key)
+        if bp and p:
+            import jax
+
+            p = jax.tree.map(lambda l: l[bp["slot"]], p)
+        return p
 
     def _honored_ctx(self):
         return self.machine.honored_placements(
@@ -544,12 +644,20 @@ class FFModel:
                     dp, ("n",), P("n"), rank=t.ndim)
         for entry in schedule:
             if isinstance(entry, PlacementGroup):
+                block = getattr(self, "_block_params", {})
+                pre = [block.get(m.param_key, {}).get("slot") == g
+                       and block[m.param_key]["dims"] == m.pc.dims
+                       and block[m.param_key]["strided"] == entry.strided
+                       for m, g in zip(entry.members, entry.slots)]
                 outs_by_member, states_by_member = run_group(
                     self.machine, entry,
-                    [params.get(m.param_key, {}) for m in entry.members],
+                    [params.get(m.param_key, {}) if pre[j] else
+                     self._member_params(params, m)
+                     for j, m in enumerate(entry.members)],
                     [[values[t.tid] for t in m.inputs]
                      for m in entry.members], train,
-                    [state.get(m.name, {}) for m in entry.members])
+                    [state.get(m.name, {}) for m in entry.members],
+                    prestacked=pre)
                 for m, outs, st in zip(entry.members, outs_by_member,
                                        states_by_member):
                     for t, y in zip(m.all_outputs(), outs):
@@ -571,7 +679,7 @@ class FFModel:
             xs = [values[t.tid] for t in op.inputs]
             if multi:
                 xs = self._regrid_inputs(op, xs, specs)
-            res, st = op.forward(params.get(op.param_key, {}),
+            res, st = op.forward(self._member_params(params, op),
                                  state.get(op.name, {}), xs, train)
             ys = res if isinstance(res, tuple) else (res,)
             for t, y, spec in zip(op.all_outputs(), ys, op.output_specs()):

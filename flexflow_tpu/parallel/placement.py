@@ -103,8 +103,9 @@ def placement_slot(op: Op, num_devices: int):
         return None
     if op.init_state() and op.state_specs() is None:
         return None  # stateful op without placed-state support
-    if len(set(pc.devices)) != p:
-        return None
+    if len(set(pc.devices)) != p or \
+            any(d < 0 or d >= num_devices for d in pc.devices):
+        return None  # duplicates / out-of-range ids: normalize + warn
     if num_devices % p:
         # block/stride tilings need P | N; set-family per-device dispatch
         # does not (its flat mesh just leaves more devices on the zero
@@ -552,7 +553,8 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
 def run_group(machine, group: PlacementGroup,
               params_by_member: List[Dict],
               inputs_by_member: List[List], train: bool,
-              states_by_member: Optional[List[Dict]] = None):
+              states_by_member: Optional[List[Dict]] = None,
+              prestacked: Optional[List[bool]] = None):
     """Execute a placement group jointly.  Returns
     ``(outs_by_member, new_states_by_member)``: per member, the tuple of
     its output arrays (each sliced from the group-stacked result, so it
@@ -560,18 +562,32 @@ def run_group(machine, group: PlacementGroup,
     dict ({} for stateless members)."""
     if states_by_member is None:
         states_by_member = [{} for _ in group.members]
+    hetero = len({_signature(op) for op in group.members}) > 1
+    if prestacked and any(prestacked) and (hetero
+                                           or group.device_rows is not None):
+        # these paths consume raw member trees — slice block-resident
+        # leaves back to the member's row (a rare fallback: the
+        # block-param registry excludes hetero/set groups, but schedule
+        # variants under other fusion exclusions can reshuffle members)
+        import jax
+
+        params_by_member = [
+            jax.tree.map(lambda l: l[g], p) if pre else p
+            for p, g, pre in zip(params_by_member, group.slots, prestacked)]
+        prestacked = None
     if group.device_rows is not None:
         assert all(not s for s in states_by_member), \
             "set-family groups are stateless (placement_slot gates this)"
         return _run_group_set(machine, group, params_by_member,
                               inputs_by_member, train)
-    if len({_signature(op) for op in group.members}) > 1:
+    if hetero:
         return _run_group_hetero(machine, group, params_by_member,
                                  inputs_by_member, train,
                                  states_by_member)
     return _run_group_homogeneous(machine, group, params_by_member,
                                   inputs_by_member, train,
-                                  states_by_member)
+                                  states_by_member,
+                                  prestacked or [False] * len(group.members))
 
 
 def set_group_assignment(group: PlacementGroup,
@@ -721,7 +737,8 @@ def _run_group_set(machine, group: PlacementGroup,
 def _run_group_homogeneous(machine, group: PlacementGroup,
                            params_by_member: List[Dict],
                            inputs_by_member: List[List], train: bool,
-                           states_by_member: List[Dict]):
+                           states_by_member: List[Dict],
+                           prestacked: Optional[List[bool]] = None):
     """Same-signature members: params (and state, round 3 — lifting the
     BatchNorm exclusion) stacked leaf-wise over the group axis with their
     inner sharding preserved; every branch shares one output aval.
@@ -743,15 +760,44 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
     slots = group.slots
     k_in = len(op0.input_specs())
 
+    prestacked = prestacked or [False] * len(ops)
+
     def stack_leaf(*member_leaves):
         by = dict(zip(slots, member_leaves))
         z = jnp.zeros_like(member_leaves[0])
         return jnp.stack([by.get(g, z) for g in range(G)])
 
+    def stack_param_leaf(*member_leaves):
+        """(G, ...) group-stacked PARAM leaf.  BLOCK-RESIDENT members
+        arrive already stacked and _pg-sharded
+        (model._derive_block_params) — their rows merge by a one-hot
+        mask-sum, all block-local, so no parameter byte crosses the
+        group axis (on a two-tier machine, DCN); legacy unstacked
+        members go through jnp.stack as before (GSPMD reshards them to
+        the group layout).  State always takes the plain stack_leaf
+        path — the prestacked flags describe params only."""
+        by = {}
+        pre = []
+        for leaf, g, p in zip(member_leaves, slots, prestacked):
+            if p:
+                io = jax.lax.broadcasted_iota(
+                    jnp.int32, (G,) + (1,) * (leaf.ndim - 1), 0)
+                pre.append(jnp.where(io == g, leaf,
+                                     jnp.zeros_like(leaf)))
+            else:
+                by[g] = leaf
+        out = None
+        if by:
+            z = jnp.zeros_like(next(iter(by.values())))
+            out = jnp.stack([by.get(g, z) for g in range(G)])
+        for v in pre:
+            out = v if out is None else out + v
+        return out
+
     # ---- stack params along the group axis (zeros in unowned blocks) ----
     have_params = bool(params_by_member and params_by_member[0])
     if have_params:
-        stacked = jax.tree.map(stack_leaf, *params_by_member)
+        stacked = jax.tree.map(stack_param_leaf, *params_by_member)
         pspecs = {k: P("_pg", *spec)
                   for k, spec in op0.param_specs().items()}
     else:

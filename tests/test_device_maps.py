@@ -355,13 +355,8 @@ def test_non_dividing_subset_honored():
     n = machine.num_devices
     if n != 8:
         pytest.skip("device list assumes the 8-device test mesh")
-    import logging
-
-    s = Strategy()
-    s["fc1"] = ParallelConfig((1, 3), (0, 3, 5))
-    # 64 output channels and batch 16 divide nothing by 3 — shard the
-    # batch? no: (1, 3) splits batch 16 by 3 unevenly, so use a (3, 1)
-    # channel split of a 48-wide linear instead
+    # a (3, 1) channel split of a 48-wide linear: batch 16 and 64
+    # channels divide nothing by 3, 48 does
     s2 = Strategy()
     s2["fc1"] = ParallelConfig((3, 1), (0, 3, 5))
 
@@ -376,7 +371,6 @@ def test_non_dividing_subset_honored():
         ff.softmax("softmax", t)
         return ff
 
-    with_cap = logging.getLogger("flexflow_tpu.machine")
     import numpy as np
 
     ff = build(s2, 48)

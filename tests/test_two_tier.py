@@ -1,0 +1,229 @@
+"""Grounding the two-tier (ICI/DCN) search wins outside the simulator
+(VERDICT r3 #4): every >1x claim on the 2x4 topology previously existed
+only in simulation.
+
+(a) Compiled-HLO collective audit: lower the committed alexnet_2x4 plan
+    and pure DP on a 2x4 machine view and compare CROSS-GROUP collective
+    bytes — the volume that rides the DCN tier.  Recorded (batch 16,
+    f32, 8-dev virtual mesh): searched 12.1 MB vs DP 244.4 MB per step,
+    a ~20x reduction — the compiled-program counterpart of the simulated
+    2.80x step win (examples/strategies/summary.json).
+
+    This audit is also what exposed (and now guards) a real executor
+    gap: before round 4's block-resident parameter storage
+    (model._derive_block_params), placed-group params entered the jit on
+    the normalized sharding and were re-stacked across the group axis
+    every step — 435 MB of cross-group traffic, i.e. MORE than DP, and
+    the simulated win did not exist in the executed program.
+
+(b) The committed plan runs across a REAL two-process boundary (the
+    process split IS the 2x4 DCN boundary, gloo transport) with the loss
+    trajectory matching the single-process run; per-step wall times are
+    recorded in the test output (on shared host cores they measure total
+    work, not the DCN win — the bytes audit above is the tier evidence).
+"""
+
+import re
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+STRATEGY = "examples/strategies/alexnet_2x4.json"
+
+_DT = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1,
+       "u8": 1, "pred": 1, "f64": 8, "s64": 8}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                "collective-permute", "all-reduce-start", "all-gather-start",
+                "collective-permute-start")
+
+
+def collective_bytes(hlo: str, group_size: int):
+    """(cross_group_bytes, intra_bytes) over all collectives in optimized
+    HLO text; cross = any replica group (brace or iota form) or permute
+    pair spanning ICI groups of ``group_size`` consecutive devices."""
+    cross = intra = 0.0
+    for m in re.finditer(
+            r"= ?((?:\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)) ([a-z\-]+)\(",
+            hlo):
+        shape_s, op = m.group(1), m.group(2)
+        if op not in _COLLECTIVES:
+            continue
+        line = hlo[m.start():hlo.index("\n", m.start())]
+        nbytes = 0
+        for sm in re.finditer(r"([a-z0-9]+)\[([0-9,]*)\]", shape_s):
+            dt, dims = sm.group(1), sm.group(2)
+            if dt not in _DT:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DT[dt]
+        is_cross = False
+        rg = re.search(r"replica_groups=\{(\{[0-9,\}\{]*\})\}", line)
+        if rg:
+            for grp in re.findall(r"\{([0-9,]+)\}", rg.group(1)):
+                ids = [int(x) for x in grp.split(",")]
+                if len({i // group_size for i in ids}) > 1:
+                    is_cross = True
+                    break
+        ri = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+                       r"(?:T\(([0-9,]+)\))?", line)
+        if ri:
+            ng, gs = int(ri.group(1)), int(ri.group(2))
+            dims = [int(x) for x in ri.group(3).split(",")]
+            arr = np.arange(int(np.prod(dims))).reshape(dims)
+            if ri.group(4):
+                arr = arr.transpose(
+                    [int(x) for x in ri.group(4).split(",")])
+            for ids in arr.reshape(ng, gs):
+                if len({int(i) // group_size for i in ids}) > 1:
+                    is_cross = True
+                    break
+        stp = re.search(r"source_target_pairs=\{([0-9,\{\}]*)\}", line)
+        if stp:
+            for pair in re.findall(r"\{([0-9]+),([0-9]+)\}", stp.group(1)):
+                if int(pair[0]) // group_size != int(pair[1]) // group_size:
+                    is_cross = True
+                    break
+        if is_cross:
+            cross += nbytes
+        else:
+            intra += nbytes
+    return cross, intra
+
+
+def _compiled_alexnet(machine8, strategy_file: str) -> str:
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.data import synthetic_batches
+    from flexflow_tpu.machine import MachineModel, Topology
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    machine = MachineModel(topology=Topology(devices_per_ici_group=4))
+    cfg = FFConfig(batch_size=16, input_height=224, input_width=224,
+                   num_iterations=1, print_freq=0, seed=3,
+                   strategy_file=strategy_file)
+    ff = build_alexnet(cfg, machine)
+    params, state = ff.init()
+    opt = ff.init_opt_state(params)
+    step = ff.make_train_step()
+    data = synthetic_batches(machine, 16, 224, 224, mode="ones")
+    img, lbl = next(data)
+    return step.lower(params, state, opt, img, lbl).compile().as_text()
+
+
+def test_two_tier_hlo_collective_audit(machine8):
+    """The searched 2x4 plan's cross-group (DCN) collective bytes are a
+    small fraction of DP's in the COMPILED program — the simulator's
+    claimed physics, validated on the executable."""
+    searched = _compiled_alexnet(machine8, STRATEGY)
+    dp = _compiled_alexnet(machine8, "")
+    s_cross, s_intra = collective_bytes(searched, 4)
+    d_cross, d_intra = collective_bytes(dp, 4)
+    print(f"cross-group bytes/step: searched {s_cross/1e6:.2f} MB "
+          f"(intra {s_intra/1e6:.2f}) vs DP {d_cross/1e6:.2f} MB "
+          f"(intra {d_intra/1e6:.2f}); ratio {d_cross/max(s_cross,1):.1f}x")
+    assert d_cross > 0, "DP must cross the tier (its grads span the machine)"
+    # recorded 20.2x (12.1 vs 244.4 MB); assert a conservative 5x floor
+    assert s_cross < d_cross / 5, (
+        f"searched plan moves {s_cross/1e6:.1f} MB across the DCN tier vs "
+        f"DP's {d_cross/1e6:.1f} MB — the simulated two-tier win is not "
+        f"realized in the compiled program")
+
+
+_WORKER = textwrap.dedent('''
+import os, sys, time
+pid, port = int(sys.argv[1]), sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from flexflow_tpu import distributed
+machine = distributed.initialize(coordinator_address="localhost:" + port,
+                                 num_processes=2, process_id=pid)
+assert machine.num_devices == 8
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.data import synthetic_batches
+from flexflow_tpu.models.alexnet import build_alexnet
+cfg = FFConfig(batch_size=16, input_height=224, input_width=224,
+               num_iterations=2, print_freq=0, seed=3,
+               strategy_file="examples/strategies/alexnet_2x4.json")
+ff = build_alexnet(cfg, machine)
+params, state = ff.init()
+opt = ff.init_opt_state(params)
+step = ff.make_train_step()
+data = synthetic_batches(machine, 16, 224, 224, mode="random", seed=7)
+losses, times = [], []
+for _ in range(2):
+    img, lbl = next(data)
+    t0 = time.perf_counter()
+    params, state, opt, loss = step(params, state, opt, img, lbl)
+    losses.append(float(loss))  # float() also syncs the step
+    times.append(time.perf_counter() - t0)
+print("LOSSES", " ".join(f"{l:.6f}" for l in losses), flush=True)
+print("TIMES", " ".join(f"{t:.3f}" for t in times), flush=True)
+''')
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_searched_plan_across_real_process_boundary(machine8):
+    """The committed 2x4 plan executes across a REAL 2-process boundary
+    (= the DCN tier: subset-placed FC groups live entirely inside one
+    process, their collectives never touch the inter-process link) with
+    the loss trajectory of the single-process run; step wall times are
+    recorded in the output."""
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, str(i), port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+    losses, times = [], []
+    for out in outs:
+        lines = out.splitlines()
+        losses.append([float(v) for v in
+                       [l for l in lines if l.startswith("LOSSES")][0]
+                       .split()[1:]])
+        times.append([float(v) for v in
+                      [l for l in lines if l.startswith("TIMES")][0]
+                      .split()[1:]])
+    print(f"2-process step times (s): {times[0]} / {times[1]}")
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+
+    # single-process reference on the same data
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.data import synthetic_batches
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    cfg = FFConfig(batch_size=16, input_height=224, input_width=224,
+                   num_iterations=2, print_freq=0, seed=3,
+                   strategy_file=STRATEGY)
+    ff = build_alexnet(cfg, machine8)
+    params, state = ff.init()
+    opt = ff.init_opt_state(params)
+    step = ff.make_train_step()
+    data = synthetic_batches(machine8, 16, 224, 224, mode="random", seed=7)
+    ref = []
+    for _ in range(2):
+        img, lbl = next(data)
+        params, state, opt, loss = step(params, state, opt, img, lbl)
+        ref.append(float(loss))
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-4)
