@@ -22,6 +22,7 @@ from flexflow_tpu.config import FFConfig
 from flexflow_tpu.data import synthetic_batches
 from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.models.alexnet import build_alexnet
+from flexflow_tpu.strategy import ParallelConfig
 
 ARTIFACT = "examples/strategies/alexnet_8dev_measured.json"
 
@@ -58,11 +59,68 @@ def test_searched_strategy_beats_dp_wall_clock():
     t_searched, loss_s = _step_time(machine, ARTIFACT)
     # same training semantics ...
     assert loss_s == pytest.approx(loss_dp, rel=2e-3)
-    # ... measurably faster in wall-clock (measured ~1.25x on an idle
-    # rig).  Timing under ambient load is noisy: retry once before
-    # declaring a regression.
-    if not t_searched < t_dp:
+    # ... measurably faster in wall-clock, with a MARGIN floor (VERDICT
+    # r3 weak #3: a noise-level 1.01x must not pass where BASELINE.md
+    # claims 1.25x).  Timing under ambient load is noisy: retry once
+    # before declaring a regression.
+    if not t_searched * 1.10 < t_dp:
         t_dp, _ = _step_time(machine, None)
         t_searched, _ = _step_time(machine, ARTIFACT)
-    assert t_searched < t_dp, \
-        f"searched {t_searched:.2f}s vs DP {t_dp:.2f}s per step"
+    ratio = t_dp / t_searched
+    print(f"searched-vs-DP wall-clock ratio: {ratio:.2f}x "
+          f"(searched {t_searched:.2f}s, DP {t_dp:.2f}s per step)")
+    assert ratio >= 1.10, \
+        f"searched {t_searched:.2f}s vs DP {t_dp:.2f}s per step " \
+        f"({ratio:.2f}x < the 1.10x floor; BASELINE.md claims ~1.25x)"
+
+
+def test_searched_nmt_beats_dp_wall_clock():
+    """Same harness for NMT (VERDICT r3 #6): nmt_8dev_measured's vocab-TP
+    projection is a TOTAL-WORK reduction (each device streams only its
+    vocab slice of the 20k-wide head), which the shared-core virtual
+    mesh can measure, like AlexNet's FC TP."""
+    from flexflow_tpu.nmt.rnn_model import (RnnConfig, RnnModel,
+                                            synthetic_token_batches)
+    from flexflow_tpu.strategy import Strategy
+
+    machine = MachineModel()
+    if machine.num_devices < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    cfg = RnnConfig(batch_size=16, num_layers=2, seq_length=20,
+                    hidden_size=256, embed_size=256, vocab_size=4096,
+                    learning_rate=0.05, seed=3)
+
+    def step_time(strategies, iters=4):
+        model = RnnModel(cfg, machine, strategies)
+        data = synthetic_token_batches(machine, cfg.batch_size,
+                                       cfg.seq_length, cfg.vocab_size,
+                                       seed=11)
+        params, state = model.init()
+        step = model.make_train_step()
+        b = next(data)
+        for _ in range(2):
+            params, state, _, loss = step(params, state, None, *b)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, state, _, loss = step(params, state, None, *b)
+        float(loss)
+        return (time.perf_counter() - t0) / iters, float(loss)
+
+    # the committed artifact targets the full-size NMT; rebuild its SHAPE
+    # (vocab-TP projection head, DP elsewhere) at the CPU-scaled config
+    n = machine.num_devices
+    s = Strategy()
+    for j in range(cfg.chunks_per_seq):
+        s[f"linear{j}"] = ParallelConfig((n, 1), tuple(range(n)))
+    t_dp, loss_dp = step_time(None)
+    t_tp, loss_tp = step_time(s)
+    if not t_tp * 1.05 < t_dp:
+        t_dp, _ = step_time(None)
+        t_tp, _ = step_time(s)
+    ratio = t_dp / t_tp
+    print(f"NMT vocab-TP-vs-DP wall-clock ratio: {ratio:.2f}x "
+          f"(TP {t_tp:.2f}s, DP {t_dp:.2f}s per step)")
+    assert loss_tp == pytest.approx(loss_dp, rel=2e-3)
+    assert ratio >= 1.05, \
+        f"vocab-TP {t_tp:.2f}s vs DP {t_dp:.2f}s ({ratio:.2f}x)"
