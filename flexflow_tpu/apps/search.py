@@ -109,6 +109,23 @@ def main(argv=None, log=print) -> dict:
         "best_time_s": info["best_time"],
         "speedup_vs_dp": info["speedup_vs_dp"],
     }
+    if opts["model"] in ("transformer", "gpt", "bert"):
+        # the GPipe scheduler configuration joins the search space for
+        # the LM (round 4, VERDICT r3 #5): propose-or-reject a pipeline
+        # block with every candidate's cost logged, feasibility-gated on
+        # the executor's divisibility rules, accepted only when it beats
+        # the best NON-pipelined plan (it replaces the per-op entries in
+        # the consuming driver).  NMT is excluded: no NMT driver consumes
+        # the block (PipelinedLM is a transformer stack).
+        pp = search.propose_pipeline(
+            log=log, reference_s=info["best_time"],
+            stage_divisor=model.t.num_layers,
+            batch=model.t.batch_size)
+        result["pipeline"] = {
+            "accepted": pp["accepted"], "best": pp["best"],
+            "reference_time_s": pp["reference_time_s"]}
+        if pp["accepted"]:
+            strategy.pipeline = pp["best"]
     log(json.dumps(result))
     if opts["out"]:
         strategy.save(opts["out"])

@@ -639,6 +639,119 @@ class StrategySearch:
     def simulate(self, assignment: Sequence[int]) -> float:
         return self.sim.simulate(assignment) + self._opt_stream_s
 
+    def propose_pipeline(self, stage_options=None,
+                         micro_options=(2, 4, 8), log=None,
+                         reference_s=None, stage_divisor=None,
+                         batch=None):
+        """Cost GPipe (S stages x M microbatches) candidates against the
+        plain (non-pipelined) DP execution and propose-or-reject a
+        ``pipeline`` block for the strategy file (round 4, VERDICT r3
+        #5 — the framework owns a scheduler the reference lacks, so the
+        searcher must own its configuration too).
+
+        Cost model per candidate: per-layer DP shard times scale by S/M
+        (stage meshes have N/S devices, microbatches are B/M); layers
+        greedily partition into S contiguous stages; the pipeline runs
+        (M + S - 1) ticks of the max stage makespan (the GPipe bubble,
+        parallel/pipeline.py), plus the boundary activations each
+        microbatch ppermutes across every cut (fwd + bwd), plus the
+        stage-local parameter sync and the assignment-invariant
+        optimizer stream.  Logged per candidate so a rejection is an
+        auditable decision, not a silent one."""
+        import logging
+
+        logger = log or logging.getLogger(__name__).info
+        n = self.machine.num_devices
+        topo = self.machine.topology
+        dp = self.dp_assignment()
+        # the bar to beat is the best NON-pipelined plan known: an
+        # accepted pipeline replaces the per-op plan in the consuming
+        # driver, so beating plain DP alone could regress a better
+        # searched plan (round-4 review)
+        t_ref = self.simulate(dp)
+        if reference_s is not None:
+            t_ref = min(t_ref, float(reference_s))
+        layer_ops = []
+        layer_costs = []
+        for op, cands, idx in zip(self.ops, self.candidates, dp):
+            if isinstance(op, _InputSource):
+                continue
+            layer_ops.append(op)
+            layer_costs.append(self.cost_model.op_cost(op, cands[idx]))
+        total_param_bytes = sum(
+            float(op.param_bytes()) for op in layer_ops)
+        if stage_options is None:
+            stage_options = [s for s in (2, 4, 8)
+                             if n % s == 0 and s < n
+                             and s <= len(layer_ops)
+                             and (stage_divisor is None
+                                  or stage_divisor % s == 0)]
+        # only microbatch counts the GPipe executor admits
+        # (parallel/pipeline.py: batch % M == 0 and (batch//M) % dp == 0)
+        feasible_micro = {}
+        for S in stage_options:
+            dp_width = max(n // S, 1)
+            feasible_micro[S] = [
+                m for m in micro_options
+                if batch is None or (batch % m == 0
+                                     and (batch // m) % dp_width == 0)]
+        candidates = []
+        for S in stage_options:
+            scale = float(S)
+            # greedy contiguous balance of the (M-independent) stage load
+            base = [c * scale for c in layer_costs]
+            target = sum(base) / S
+            cuts, acc, left = [], 0.0, S
+            for i, ti in enumerate(base):
+                acc += ti
+                rest = len(base) - i - 1
+                if left > 1 and (acc >= target or rest < left):
+                    cuts.append(i)
+                    acc, left = 0.0, left - 1
+            stage_sums, s_acc, ci = [], 0.0, 0
+            for i, ti in enumerate(base):
+                s_acc += ti
+                if ci < len(cuts) and i == cuts[ci]:
+                    stage_sums.append(s_acc)
+                    s_acc, ci = 0.0, ci + 1
+            stage_sums.append(s_acc)
+            # boundary activation bytes per device (fwd + bwd), summed
+            # over the M microbatches = one full crossing of each cut
+            comm = 0.0
+            for i in cuts:
+                import math as _m
+
+                bytes_cut = 4.0 * _m.prod(layer_ops[i].output.shape)
+                comm += 2.0 * bytes_cut / max(n // S, 1) \
+                    / topo.ici_bandwidth
+            sync = 2.0 * (total_param_bytes / S) \
+                * max(n // S - 1, 0) / max(n // S, 1) / topo.ici_bandwidth
+            for M in feasible_micro[S]:
+                L = max(stage_sums) / M
+                t = (M + S - 1) * L + comm + sync + self._opt_stream_s
+                candidates.append({
+                    "stages": S, "microbatches": M,
+                    "time_s": t, "stage_makespan_s": L,
+                    "bubble_factor": (M + S - 1) / M,
+                    "comm_s": comm, "param_sync_s": sync})
+                logger(
+                    "pipeline candidate S=%d M=%d: %.4fs (makespan "
+                    "%.4fs x %.2f bubble + %.4fs comm + %.4fs sync) "
+                    "vs %.4fs non-pipelined" % (S, M, t, L,
+                                           (M + S - 1) / M, comm, sync,
+                                           t_ref))
+        best = min(candidates, key=lambda c: c["time_s"], default=None)
+        accepted = bool(best and best["time_s"] < t_ref)
+        logger("pipeline decision: %s (best %s vs non-pipelined %.4fs)"
+               % ("ACCEPT" if accepted else "REJECT",
+                  f"S={best['stages']} M={best['microbatches']} "
+                  f"{best['time_s']:.4f}s" if best else "none", t_ref))
+        return {"candidates": candidates, "reference_time_s": t_ref,
+                "accepted": accepted,
+                "best": ({"stages": best["stages"],
+                          "microbatches": best["microbatches"]}
+                         if accepted else None)}
+
     def search(self, iters: int = 250_000, beta: float = 5e3,
                seed: int = 0):
         """MCMC from the DP start point (reference: scripts/simulator.cc

@@ -105,6 +105,14 @@ class Strategy(dict):
         exchanged with the reference implementation.
     """
 
+    #: optional GPipe block the drivers consume (round 4, VERDICT r3 #5):
+    #: {"stages": S, "microbatches": M} — emitted by the searcher's
+    #: propose_pipeline, honored by apps/lm.py (and ignored by per-op
+    #: execution, which has no scheduler role).  JSON-only: the proto2
+    #: wire format stays byte-compatible with the reference, which has
+    #: no scheduler to describe (SURVEY §2.6 PP).
+    pipeline = None
+
     # ---------- JSON ----------
 
     def to_json(self) -> str:
@@ -112,12 +120,20 @@ class Strategy(dict):
             name: {"dims": list(pc.dims), "devices": list(pc.devices)}
             for name, pc in self.items()
         }
+        if self.pipeline:
+            obj["__pipeline__"] = {
+                "stages": int(self.pipeline["stages"]),
+                "microbatches": int(self.pipeline["microbatches"])}
         return json.dumps(obj, indent=2, sort_keys=True)
 
     @classmethod
     def from_json(cls, text: str) -> "Strategy":
         obj = json.loads(text)
         s = cls()
+        pp = obj.pop("__pipeline__", None)
+        if pp:
+            s.pipeline = {"stages": int(pp["stages"]),
+                          "microbatches": int(pp["microbatches"])}
         for name, d in obj.items():
             s[name] = ParallelConfig(tuple(d["dims"]), tuple(d["devices"]))
         return s
