@@ -537,14 +537,15 @@ class FFModel:
         collective audit, tests/test_two_tier.py; the reference keeps
         non-shared weights on their op's GPUs, linear.cu:95-124).
 
-        Eligible: members of HOMOGENEOUS block/stride groups whose
+        Eligible: members of block/stride groups (homogeneous AND, since
+        the round-4 follow-up, heterogeneous — the hetero runner builds
+        its group vector row-wise from the stacked leaves) whose
         param_key is used by exactly ONE layer (shared keys — the NMT
         SharedVariable pattern — may appear in several groups at
         different slots, which one stacked copy cannot serve) and is not
         a fused-LM-head candidate (that path consumes raw leaves)."""
         from flexflow_tpu.ops.rnn_linear import RnnLinear
-        from flexflow_tpu.parallel.placement import (PlacementGroup,
-                                                     _signature)
+        from flexflow_tpu.parallel.placement import PlacementGroup
 
         uses: Dict[str, int] = {}
         for op in self.layers:
@@ -555,8 +556,9 @@ class FFModel:
                 continue
             if entry.device_rows is not None:
                 continue  # set family replicates operands by design
-            if len({_signature(m) for m in entry.members}) > 1:
-                continue  # hetero path ravels params into group vectors
+            # homogeneous AND hetero groups qualify (round 4): the hetero
+            # runner ravels each member's row slice into its group-vector
+            # slot, which stays on the member's block
             for m, g in zip(entry.members, entry.slots):
                 if (uses.get(m.param_key) == 1 and m.param_specs()
                         and not isinstance(m, RnnLinear)):

@@ -563,11 +563,10 @@ def run_group(machine, group: PlacementGroup,
     if states_by_member is None:
         states_by_member = [{} for _ in group.members]
     hetero = len({_signature(op) for op in group.members}) > 1
-    if prestacked and any(prestacked) and (hetero
-                                           or group.device_rows is not None):
-        # these paths consume raw member trees — slice block-resident
-        # leaves back to the member's row (a rare fallback: the
-        # block-param registry excludes hetero/set groups, but schedule
+    if prestacked and any(prestacked) and group.device_rows is not None:
+        # the set-family path consumes raw member trees — slice
+        # block-resident leaves back to the member's row (a rare
+        # fallback: the registry excludes set groups, but schedule
         # variants under other fusion exclusions can reshuffle members)
         import jax
 
@@ -583,7 +582,8 @@ def run_group(machine, group: PlacementGroup,
     if hetero:
         return _run_group_hetero(machine, group, params_by_member,
                                  inputs_by_member, train,
-                                 states_by_member)
+                                 states_by_member,
+                                 prestacked or [False] * len(group.members))
     return _run_group_homogeneous(machine, group, params_by_member,
                                   inputs_by_member, train,
                                   states_by_member,
@@ -883,7 +883,8 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
 def _run_group_hetero(machine, group: PlacementGroup,
                       params_by_member: List[Dict],
                       inputs_by_member: List[List], train: bool,
-                      states_by_member: Optional[List[Dict]] = None):
+                      states_by_member: Optional[List[Dict]] = None,
+                      prestacked: Optional[List[bool]] = None):
     """Mixed-kind members (round 3; generalized round 4): each member is
     its own switch branch.
 
@@ -946,16 +947,19 @@ def _run_group_hetero(machine, group: PlacementGroup,
     assert all(v is not None for v in views), \
         "grouping admitted a member the owner grid cannot host"
 
-    def ravel_tree(tree, what, name):
-        leaves, treedef = jax.tree.flatten(tree)
+    def check_f32_family(leaves, what, name):
+        # the vector rides through f32: exact for f32/bf16/f16 leaves,
+        # lossy for anything else — fail loudly rather than corrupt
         for l in leaves:
-            # the vector rides through f32: exact for f32/bf16/f16 leaves,
-            # lossy for anything else — fail loudly rather than corrupt
             if str(l.dtype) not in ("float32", "bfloat16", "float16"):
                 raise TypeError(
                     f"heterogeneous placement of {name!r}: {what} dtype "
                     f"{l.dtype} does not round-trip through the f32 "
                     f"group vector")
+
+    def ravel_tree(tree, what, name):
+        leaves, treedef = jax.tree.flatten(tree)
+        check_f32_family(leaves, what, name)
         vec = jnp.concatenate([l.ravel().astype(jnp.float32)
                                for l in leaves]) \
             if leaves else jnp.zeros((0,), jnp.float32)
@@ -969,12 +973,46 @@ def _run_group_hetero(machine, group: PlacementGroup,
         return jnp.stack([by_slot.get(g, zero) for g in range(G)]), lmax
 
     # ---- params and state: flatten -> f32 ravel -> pad -> stack ----
-    pvecs, metas = [], []
-    for m, p in zip(ops, params_by_member):
-        v, meta = ravel_tree(p, "param", m.name)
-        pvecs.append(v)
-        metas.append(meta)
-    stacked, _ = stack_vecs(pvecs)
+    # BLOCK-RESIDENT members (model._derive_block_params) arrive as
+    # stacked (G, ...) leaves.  Their group vector is built ROW-WISE —
+    # reshape (G, -1) keeping the sharded group dim, concat along the
+    # vector dim, pad, one-hot-mask the member's row — every op per-row
+    # local, so no parameter byte crosses the group axis (a row SLICE
+    # would: GSPMD lowers cross-_pg slicing to gathers, measured as MORE
+    # collectives than the legacy restack)
+    prestacked = prestacked or [False] * len(ops)
+    metas = []
+    legacy = []        # (slot, 1-D vec) for plain members
+    pre_rows = []      # (slot, (G, L_m) row-local vectors) for prestacked
+    for m, p, g, pre in zip(ops, params_by_member, slots, prestacked):
+        if pre:
+            leaves, treedef = jax.tree.flatten(p)
+            check_f32_family(leaves, "param", m.name)
+            for l in leaves:
+                assert l.shape[0] == G, (
+                    f"block-resident leaf of {m.name!r} stacked for "
+                    f"{l.shape[0]} groups, mesh has {G} — mis-stacked "
+                    f"storage would scramble rows silently")
+            rowvec = jnp.concatenate(
+                [l.reshape(G, -1).astype(jnp.float32) for l in leaves],
+                axis=1) if leaves else jnp.zeros((G, 0), jnp.float32)
+            pre_rows.append((g, rowvec))
+            metas.append((treedef,
+                          [(l.shape[1:], str(l.dtype)) for l in leaves]))
+        else:
+            v, meta = ravel_tree(p, "param", m.name)
+            legacy.append((g, v))
+            metas.append(meta)
+    lmax = max([r.shape[1] for _, r in pre_rows] +
+               [v.shape[0] for _, v in legacy] + [0])
+    by_slot = {g: jnp.pad(v, (0, lmax - v.shape[0])) for g, v in legacy}
+    zero = jnp.zeros((lmax,), jnp.float32)
+    stacked = jnp.stack([by_slot.get(g, zero) for g in range(G)])
+    for g, rowvec in pre_rows:
+        padded = jnp.pad(rowvec, ((0, 0), (0, lmax - rowvec.shape[1])))
+        io = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+        stacked = stacked + jnp.where(io == g, padded,
+                                      jnp.zeros_like(padded))
     svecs, smetas = [], []
     for m, st in zip(ops, states_by_member):
         v, meta = ravel_tree(st, "state", m.name)
@@ -991,8 +1029,9 @@ def _run_group_hetero(machine, group: PlacementGroup,
     real_avals = []
     for m in range(len(ops)):
         def fwd(m=m):
-            res, _ = ops[m].forward(params_by_member[m],
-                                    states_by_member[m],
+            p = jax.tree.map(lambda l: l[slots[m]], params_by_member[m]) \
+                if prestacked[m] else params_by_member[m]
+            res, _ = ops[m].forward(p, states_by_member[m],
                                     inputs_by_member[m], train)
             return res if isinstance(res, tuple) else (res,)
         real_avals.append(jax.eval_shape(fwd))
