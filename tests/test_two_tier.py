@@ -227,3 +227,51 @@ def test_searched_plan_across_real_process_boundary(machine8):
         params, state, opt, loss = step(params, state, opt, img, lbl)
         ref.append(float(loss))
     np.testing.assert_allclose(losses[0], ref, rtol=1e-4)
+
+
+@pytest.mark.xfail(strict=False, reason=(
+    "round-4 finding: the committed transformer_2x4 plan's 1.64x is "
+    "simulation-only and FALSIFIED by this audit — at the searched shape "
+    "the compiled program moves ~8x MORE cross-tier bytes than DP "
+    "(~4.3 GB vs 543 MB): the plan's non-canonical head placements "
+    "defeat the fused vocab head, so full logits materialize and "
+    "repartition across the tier and the 100 MB vocab kernel re-gathers "
+    "per step.  Claim withdrawn in summary.json; making the searcher's "
+    "pricing see these executor paths is a round-5 item."))
+def test_two_tier_transformer_audit(machine8):
+    """The same audit applied to the second two-tier claim
+    (transformer_2x4.json) — currently an honest failure, kept visible
+    as an xfail so the gap cannot silently regress into a 'grounded'
+    claim."""
+    from flexflow_tpu.data import synthetic_token_stream
+    from flexflow_tpu.machine import MachineModel, Topology
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from flexflow_tpu.strategy import Strategy
+
+    machine = MachineModel(topology=Topology(devices_per_ici_group=4))
+
+    def compiled(strategy_file):
+        cfg = TransformerConfig(seed=3)     # the searched shape
+        strategies = Strategy.load(strategy_file) if strategy_file \
+            else None
+        model = TransformerLM(cfg, machine, strategies)
+        params, state = model.init()
+        step = model.make_train_step()
+        gen = synthetic_token_stream(machine, cfg.batch_size,
+                                     cfg.seq_length, cfg.vocab_size,
+                                     seed=5, streams=1)
+        (toks,) = next(gen)
+        return step.lower(params, state, None, toks,
+                          toks).compile().as_text()
+
+    searched = compiled("examples/strategies/transformer_2x4.json")
+    dp = compiled("")
+    s_cross, _ = collective_bytes(searched, 4)
+    d_cross, _ = collective_bytes(dp, 4)
+    print(f"LM cross-group bytes/step: searched {s_cross/1e6:.1f} MB "
+          f"vs DP {d_cross/1e6:.1f} MB")
+    assert d_cross > 0
+    assert s_cross < d_cross, (
+        f"searched LM plan moves {s_cross/1e6:.1f} MB across the DCN "
+        f"tier vs DP's {d_cross/1e6:.1f} MB")
