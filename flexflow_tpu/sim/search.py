@@ -264,7 +264,7 @@ def candidate_configs(op: Op, num_devices: int,
                       max_per_axis: Optional[Dict[str, int]] = None,
                       placement: bool = True,
                       stats: Optional[Dict[str, int]] = None
-                      ) -> List[ParallelConfig]:
+                      , subset_ok=True) -> List[ParallelConfig]:
     """Power-of-2 grids (the reference constrains the search the same way,
     scripts/simulator.cc:143-151) whose product divides the machine and
     whose dims divide the tensor extents they partition — except spatial
@@ -313,8 +313,13 @@ def candidate_configs(op: Op, num_devices: int,
             stats.get("axis_options_pruned", 0) + pruned
     out = []
     # mirror placement_slot's gate: stateful ops place when they support
-    # placed-state threading (round 3: BatchNorm's state_specs)
-    placeable = placement and op.placement_signature() is not None \
+    # placed-state threading (round 3: BatchNorm's state_specs); callers
+    # may veto subset placement entirely (subset_ok=False, e.g. LM head
+    # ops whose sub-machine placement de-fuses the vocab head into a
+    # logit-materializing path the simulator does not price — the
+    # round-4 two-tier audit's falsification mechanism)
+    placeable = subset_ok and placement \
+        and op.placement_signature() is not None \
         and not (op.init_state() and op.state_specs() is None)
 
     def emit(dims):
@@ -455,6 +460,34 @@ class StrategySearch:
         colls: List[float] = []
         pbytes: List[float] = []
         seen_param_keys = set()
+        # RnnLinear heads feeding a SoftmaxDP run the fused vocab-head
+        # kernel only on canonical device lists (model._fusion_ok);
+        # subset-placing them silently swaps in the logit-materializing
+        # path the simulator does not price, so subset candidates are
+        # withheld — but only where fusion would actually engage: the
+        # pc-independent _fusion_ok conditions (single consumer,
+        # b*s >= 2048, d <= 4096) are mirrored here.  flash_enabled() is
+        # deliberately NOT consulted: the offline search runs on CPU
+        # while its plans target TPU, where the kernel defaults on.
+        from flexflow_tpu.ops.rnn_linear import RnnLinear
+        from flexflow_tpu.ops.softmax_dp import SoftmaxDP
+
+        consumers: Dict[int, int] = {}
+        for o in self.ops:
+            for t in o.inputs:
+                consumers[t.tid] = consumers.get(t.tid, 0) + 1
+        fused_heads = set()
+        for o in self.ops:
+            if not isinstance(o, SoftmaxDP):
+                continue
+            pi = self._op_index.get(o.inputs[0].tid)
+            prod = self.ops[pi] if pi is not None else None
+            if (isinstance(prod, RnnLinear)
+                    and consumers.get(prod.output.tid) == 1
+                    and prod.inputs[0].shape[0] * prod.inputs[0].shape[1]
+                    >= 2048
+                    and prod.in_channels <= 4096):
+                fused_heads.add(id(prod))
         self.stats = {"ops": len(self.ops), "candidates": 0,
                       "mem_rejected": 0}
         for op in self.ops:
@@ -488,7 +521,8 @@ class StrategySearch:
                 continue
             cands = candidate_configs(op, n_dev, self.max_per_axis,
                                       placement=self.placement,
-                                      stats=self.stats)
+                                      stats=self.stats,
+                                      subset_ok=id(op) not in fused_heads)
             # HBM feasibility (VERDICT r2 #6): a candidate whose shard
             # footprint cannot fit the chip is not a plan, it's an OOM
             feasible = [pc for pc in cands

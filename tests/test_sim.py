@@ -226,3 +226,36 @@ def test_kind_anchor_scales_unmeasurable_candidates():
     assert m._key(b, b.pc) not in m._cache
     assert len(m._kind_ratios["Linear"]) == 1
     assert f"estimate|{m._key(b, b.pc)}" in m._foreign
+
+
+def test_fused_head_ops_get_no_subset_candidates(machine8):
+    """RnnLinear heads feeding SoftmaxDP keep only full-machine
+    candidates: subset placement would de-fuse the vocab head into the
+    logit-materializing path the simulator does not price (the round-4
+    two-tier falsification mechanism)."""
+    from flexflow_tpu.apps.search import build_model
+    from flexflow_tpu.ops.rnn_linear import RnnLinear
+    from flexflow_tpu.ops.softmax_dp import SoftmaxDP
+    from flexflow_tpu.sim.search import StrategySearch
+
+    model = build_model("transformer", machine8, 16)
+    search = StrategySearch(model, machine8)
+    n = machine8.num_devices
+    heads = set()
+    for op in model.layers:
+        if isinstance(op, SoftmaxDP):
+            prod = op.inputs[0].producer
+            if isinstance(prod, RnnLinear):
+                heads.add(prod.name)
+    assert heads, "the LM must have a fused-head candidate pair"
+    subset_elsewhere = False
+    for op, cands in zip(search.ops, search.candidates):
+        if op.name in heads:
+            assert all(pc.num_parts == n for pc in cands), \
+                f"head op {op.name} offered subset placements"
+        else:
+            subset_elsewhere = subset_elsewhere or any(
+                pc.num_parts < n for pc in cands)
+    # the veto must not leak beyond the head: other ops still search the
+    # placement dimension
+    assert subset_elsewhere, "no op kept subset placements"
