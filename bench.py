@@ -20,7 +20,8 @@ import time
 
 
 def run(model="inception", batch_size=None, iters=10, warmup=3,
-        dtype="bfloat16", strategy_file=None, compile_cache=False):
+        dtype="bfloat16", strategy_file=None, compile_cache=False,
+        windows=5):
     import jax
 
     if compile_cache:
@@ -64,15 +65,31 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
         params, state, opt_state, loss = step(params, state, opt_state,
                                               img, lbl)
     float(loss)  # full sync (the steps form one dependency chain)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        img, lbl = batches[i % 2]
-        params, state, opt_state, loss = step(params, state, opt_state,
-                                              img, lbl)
-    float(loss)
-    elapsed = time.perf_counter() - t0
+    # Variance protocol (round 5, VERDICT r4 #2): a single timed window
+    # made every per-round delta unfalsifiable.  Time ``windows``
+    # independent windows of ``iters`` steps (each closed by a full
+    # sync); report the MEDIAN and the observed spread.
+    import statistics
+
+    samples = []
+    for _ in range(max(windows, 1)):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            img, lbl = batches[i % 2]
+            params, state, opt_state, loss = step(params, state, opt_state,
+                                                  img, lbl)
+        float(loss)
+        samples.append(time.perf_counter() - t0)
+    elapsed = statistics.median(samples)
     tput = iters * batch_size / elapsed
     per_chip = tput / machine.num_devices
+    spread = {
+        "windows": len(samples),
+        "min": round(iters * batch_size / max(samples)
+                     / machine.num_devices, 2),
+        "max": round(iters * batch_size / min(samples)
+                     / machine.num_devices, 2),
+    }
 
     # MFU: FLOPs of the COMPILED step (post-fusion XLA cost analysis) over
     # elapsed time and whole-machine peak FLOPs — the pressure gauge
@@ -87,17 +104,17 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
         mfu = rl.get("mxu_utilization")
     except Exception:
         pass  # cost analysis unavailable on some backends: omit MFU
-    return per_chip, tput, elapsed, mfu
+    return per_chip, tput, elapsed, mfu, spread
 
 
 def main():
     model = os.environ.get("BENCH_MODEL", "inception")
     strategy_file = sys.argv[1] if len(sys.argv) > 1 else None
-    per_chip, tput, elapsed, mfu = run(model=model,
-                                       strategy_file=strategy_file,
-                                       compile_cache=True)
+    per_chip, tput, elapsed, mfu, spread = run(model=model,
+                                               strategy_file=strategy_file,
+                                               compile_cache=True)
     if strategy_file:
-        dp_per_chip, _, _, _ = run(model=model, compile_cache=True)
+        dp_per_chip, _, _, _, _ = run(model=model, compile_cache=True)
         vs_baseline = round(per_chip / dp_per_chip, 4)
     else:
         vs_baseline = 1.0  # benched config is itself the pure-DP baseline
@@ -108,6 +125,7 @@ def main():
         "value": round(per_chip, 2),
         "unit": "images/s/chip",
         "vs_baseline": vs_baseline,
+        "spread": spread,
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)

@@ -143,13 +143,20 @@ def main(argv=None, log=print) -> dict:
         # in driver flags); EITHER explicit pipeline flag disables the
         # block wholesale (no partial merging of file and flags)
         pp = loaded_strategies.pipeline
-        if pp:
+        if pp and pp["stages"] > 1:
             cfg._pipeline_stages = pp["stages"]
             cfg._microbatches = pp["microbatches"]
             cfg._strategy_file = ""
             log(f"pipeline block from {sf}: {pp['stages']} stages x "
                 f"{pp['microbatches']} microbatches (file-driven GPipe; "
                 f"per-op entries are advisory on this path)")
+        elif pp:
+            # a hand-edited stages<=1 block would previously clear the
+            # strategy file and then fail the >1 gate below — silently
+            # dropping BOTH the pipeline and the per-op entries (round-4
+            # ADVICE): keep the file, ignore the block, and say so
+            log(f"warning: __pipeline__ block in {sf} has stages="
+                f"{pp['stages']} <= 1 — ignored; per-op entries kept")
     if getattr(cfg, "_pipeline_stages", 0) > 1:
         unsupported = [flag for flag, on in (
             ("--strategy", bool(getattr(cfg, "_strategy_file", ""))),

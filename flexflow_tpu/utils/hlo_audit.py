@@ -1,0 +1,311 @@
+"""Compiled-HLO collective audit: executor-grounded communication
+accounting for the strategy search (round 5, VERDICT r4 #1).
+
+The reference's simulator was grounded on both axes: per-op times were
+measured on the device (ref:scripts/cnn.h:204-447) and its comm model was
+the same rectangle-intersection physics its executor (Legion) performed
+(ref:scripts/simulator.cc:886-959).  This repo measures op costs
+(protocol v3), but its comm model prices what GSPMD *should* lower — and
+round 4's audit proved GSPMD sometimes lowers something else entirely
+(the transformer_2x4 falsification: simulated 1.64x win, compiled program
+moved ~8x MORE cross-tier bytes than DP).  This module makes the compiled
+program itself the arbiter: lower the candidate plan on a virtual mesh,
+parse the optimized HLO, and count the collective bytes that cross the
+ICI-group (DCN) boundary.
+
+Two entry points:
+
+* :func:`audit_in_process` — requires ``len(jax.devices()) >= devices``
+  (tests run it on the virtual CPU mesh via conftest's machine8).
+* :func:`audit_subprocess` — spawns a fresh CPU process with
+  ``--xla_force_host_platform_device_count=<devices>`` so the audit runs
+  from ANY parent environment (including the single-chip TPU tunnel the
+  offline search runs under).  This is what ``apps/search.py``'s accept
+  path calls.
+
+The byte counter itself (:func:`collective_bytes`) is the round-4 test
+mechanism (tests/test_two_tier.py) promoted to library code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DT = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1,
+       "u8": 1, "pred": 1, "f64": 8, "s64": 8}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                "collective-permute", "all-reduce-start", "all-gather-start",
+                "collective-permute-start")
+
+
+def collective_bytes(hlo: str, group_size: int) -> Tuple[float, float]:
+    """(cross_group_bytes, intra_bytes) over all collectives in optimized
+    HLO text; cross = any replica group (brace or iota form) or permute
+    pair spanning ICI groups of ``group_size`` consecutive devices."""
+    cross = intra = 0.0
+    for m in re.finditer(
+            r"= ?((?:\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)) ([a-z\-]+)\(",
+            hlo):
+        shape_s, op = m.group(1), m.group(2)
+        if op not in _COLLECTIVES:
+            continue
+        line = hlo[m.start():hlo.index("\n", m.start())]
+        nbytes = 0
+        for sm in re.finditer(r"([a-z0-9]+)\[([0-9,]*)\]", shape_s):
+            dt, dims = sm.group(1), sm.group(2)
+            if dt not in _DT:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DT[dt]
+        is_cross = False
+        rg = re.search(r"replica_groups=\{(\{[0-9,\}\{]*\})\}", line)
+        if rg:
+            for grp in re.findall(r"\{([0-9,]+)\}", rg.group(1)):
+                ids = [int(x) for x in grp.split(",")]
+                if len({i // group_size for i in ids}) > 1:
+                    is_cross = True
+                    break
+        ri = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+                       r"(?:T\(([0-9,]+)\))?", line)
+        if ri:
+            ng, gs = int(ri.group(1)), int(ri.group(2))
+            dims = [int(x) for x in ri.group(3).split(",")]
+            arr = np.arange(int(np.prod(dims))).reshape(dims)
+            if ri.group(4):
+                arr = arr.transpose(
+                    [int(x) for x in ri.group(4).split(",")])
+            for ids in arr.reshape(ng, gs):
+                if len({int(i) // group_size for i in ids}) > 1:
+                    is_cross = True
+                    break
+        stp = re.search(r"source_target_pairs=\{([0-9,\{\}]*)\}", line)
+        if stp:
+            for pair in re.findall(r"\{([0-9]+),([0-9]+)\}", stp.group(1)):
+                if int(pair[0]) // group_size != int(pair[1]) // group_size:
+                    is_cross = True
+                    break
+        if is_cross:
+            cross += nbytes
+        else:
+            intra += nbytes
+    return cross, intra
+
+
+# ---------------------------------------------------------------------------
+# model building + lowering (one generic path for every driver family)
+
+
+def _build_model(model_name: str, machine, batch_size: Optional[int],
+                 strategy_path: str, seed: int = 3,
+                 dtype: str = "float32"):
+    """(model, example_batch) for ``model_name`` with ``strategy_path``
+    applied (empty = pure DP) — the same builders the training drivers
+    use, so the audited program IS the program a user would run."""
+    from flexflow_tpu.strategy import Strategy
+
+    strategies = Strategy.load(strategy_path) if strategy_path else None
+    if model_name == "nmt":
+        from flexflow_tpu.data import synthetic_token_stream
+        from flexflow_tpu.nmt.rnn_model import RnnConfig, RnnModel
+
+        rc = RnnConfig(seed=seed, compute_dtype=dtype)
+        if batch_size:
+            rc.batch_size = batch_size
+        model = RnnModel(rc, machine, strategies)
+        gen = synthetic_token_stream(machine, rc.batch_size, rc.seq_length,
+                                     rc.vocab_size, seed=5, streams=2)
+        return model, tuple(next(gen))
+    if model_name in ("transformer", "gpt", "bert"):
+        from flexflow_tpu.data import synthetic_token_stream
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     TransformerLM)
+
+        tc = TransformerConfig(seed=seed, compute_dtype=dtype)
+        if batch_size:
+            tc.batch_size = batch_size
+        if model_name == "gpt":
+            tc.causal = True
+        model = TransformerLM(tc, machine, strategies)
+        gen = synthetic_token_stream(machine, tc.batch_size, tc.seq_length,
+                                     tc.vocab_size, seed=5, streams=1)
+        (toks,) = next(gen)
+        return model, (toks, toks)
+    from flexflow_tpu.apps.cnn import _builders
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.data import synthetic_batches
+
+    builders = _builders()
+    if model_name not in builders:
+        raise SystemExit(f"unknown model {model_name!r}")
+    size = 299 if model_name.startswith("inception") else 224
+    b = batch_size or 16
+    cfg = FFConfig(batch_size=b, input_height=size, input_width=size,
+                   num_iterations=1, print_freq=0, seed=seed,
+                   compute_dtype=dtype, strategy_file=strategy_path)
+    model = builders[model_name](cfg, machine)
+    data = synthetic_batches(machine, b, size, size, mode="ones")
+    return model, tuple(next(data))
+
+
+def _lowered_text(model, batch) -> str:
+    params, state = model.init()
+    opt = model.init_opt_state(params)
+    step = model.make_train_step()
+    return step.lower(params, state, opt, *batch).compile().as_text()
+
+
+def audit_in_process(model_name: str, devices: int, ici_group: int,
+                     strategy_path: str,
+                     batch_size: Optional[int] = None,
+                     seed: int = 3, dtype: str = "float32",
+                     dp_known: Optional[Tuple[float, float]] = None) -> dict:
+    """Lower ``strategy_path`` AND pure DP on a ``devices``-device machine
+    view with ``ici_group``-sized ICI groups; count cross-/intra-tier
+    collective bytes of both compiled programs.  Requires that many live
+    local devices (virtual CPU mesh in practice).  ``dp_known`` =
+    (cross, intra) bytes from an earlier audit of the SAME model/shape
+    skips the (expensive, identical) DP lowering."""
+    import jax
+
+    from flexflow_tpu.machine import MachineModel, Topology
+
+    if len(jax.devices()) < devices:
+        raise RuntimeError(
+            f"audit needs {devices} devices, process has "
+            f"{len(jax.devices())} — use audit_subprocess")
+    machine = MachineModel(
+        devices=jax.devices()[:devices],
+        topology=Topology(devices_per_ici_group=ici_group))
+    out = {"model": model_name, "devices": devices,
+           "ici_group": ici_group}
+    for key, path in (("searched", strategy_path), ("dp", "")):
+        if key == "dp" and dp_known is not None:
+            cross, intra = dp_known
+        else:
+            model, batch = _build_model(model_name, machine, batch_size,
+                                        path, seed, dtype)
+            cross, intra = collective_bytes(_lowered_text(model, batch),
+                                            ici_group)
+        out[f"{key}_cross_bytes"] = cross
+        out[f"{key}_intra_bytes"] = intra
+    out["cross_ratio_dp_over_searched"] = (
+        out["dp_cross_bytes"] / max(out["searched_cross_bytes"], 1.0))
+    return out
+
+
+def audit_consistent(audit: dict, simulated_speedup: float) -> bool:
+    """Does the compiled program support the simulated two-tier claim?
+    A cross-DCN win requires the plan to move STRICTLY fewer cross-tier
+    bytes than DP; a claim of more than ~1.2x requires a clear (>=20%)
+    byte reduction, not a rounding-level one.  A plan claiming NO win
+    (speedup <= 1.05, e.g. the search honestly returned DP) is
+    consistent as long as it moves no more than DP."""
+    s, d = audit["searched_cross_bytes"], audit["dp_cross_bytes"]
+    if simulated_speedup <= 1.05:
+        return s <= d
+    if d <= 0:
+        return s <= 0  # nothing crosses the tier under DP: plan must not
+    if s >= d:
+        return False
+    if simulated_speedup > 1.2 and s > 0.8 * d:
+        return False
+    return True
+
+
+def audit_subprocess(model_name: str, devices: int, ici_group: int,
+                     strategy_path: str,
+                     batch_size: Optional[int] = None, seed: int = 3,
+                     timeout: float = 900.0,
+                     dtype: str = "float32",
+                     dp_known: Optional[Tuple[float, float]] = None) -> dict:
+    """Run :func:`audit_in_process` in a fresh CPU process with
+    ``devices`` virtual host devices — callable from any parent (the
+    offline search may be running against one real TPU chip, where an
+    8-device mesh cannot exist)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={devices}")
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "flexflow_tpu.utils.hlo_audit",
+           model_name, "--devices", str(devices),
+           "--ici-group", str(ici_group), "--seed", str(seed)]
+    if strategy_path:
+        cmd += ["--strategy", os.path.abspath(strategy_path)]
+    if batch_size:
+        cmd += ["--batch-size", str(batch_size)]
+    if dtype != "float32":
+        cmd += ["--dtype", dtype]
+    if dp_known is not None:
+        cmd += ["--dp-known", f"{dp_known[0]},{dp_known[1]}"]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=repo)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"hlo audit subprocess failed (rc {proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}")
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(
+        f"hlo audit subprocess printed no JSON:\n{proc.stdout[-2000:]}")
+
+
+def main(argv=None):
+    from flexflow_tpu.utils.flags import flag_stream
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    opts = {"model": "alexnet", "devices": 8, "ici_group": 4,
+            "strategy": "", "batch_size": None, "seed": 3,
+            "dtype": "float32", "dp_known": None}
+    if args and not args[0].startswith("-"):
+        opts["model"] = args.pop(0)
+    for a, val in flag_stream(args):
+        if a == "--devices":
+            opts["devices"] = int(val())
+        elif a == "--ici-group":
+            opts["ici_group"] = int(val())
+        elif a == "--strategy":
+            opts["strategy"] = val()
+        elif a in ("-b", "--batch-size"):
+            opts["batch_size"] = int(val())
+        elif a == "--seed":
+            opts["seed"] = int(val())
+        elif a == "--dtype":
+            opts["dtype"] = val()
+        elif a == "--dp-known":
+            c, i = val().split(",")
+            opts["dp_known"] = (float(c), float(i))
+    # force the virtual CPU mesh BEFORE any backend init: env vars alone
+    # do not suffice under the TPU tunnel (its sitecustomize pre-imports
+    # jax, same reason tests/conftest.py uses jax.config)
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={opts['devices']} "
+            + os.environ.get("XLA_FLAGS", ""))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = audit_in_process(opts["model"], opts["devices"],
+                           opts["ici_group"], opts["strategy"],
+                           opts["batch_size"], opts["seed"],
+                           opts["dtype"], opts["dp_known"])
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

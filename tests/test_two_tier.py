@@ -23,7 +23,6 @@ only in simulation.
     work, not the DCN win — the bytes audit above is the tier evidence).
 """
 
-import re
 import socket
 import subprocess
 import sys
@@ -32,70 +31,11 @@ import textwrap
 import numpy as np
 import pytest
 
+# round 5: the byte counter is library code (the search accept path uses
+# it, apps/search.py); the test keeps exercising the same mechanism
+from flexflow_tpu.utils.hlo_audit import collective_bytes
+
 STRATEGY = "examples/strategies/alexnet_2x4.json"
-
-_DT = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1,
-       "u8": 1, "pred": 1, "f64": 8, "s64": 8}
-
-_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
-                "collective-permute", "all-reduce-start", "all-gather-start",
-                "collective-permute-start")
-
-
-def collective_bytes(hlo: str, group_size: int):
-    """(cross_group_bytes, intra_bytes) over all collectives in optimized
-    HLO text; cross = any replica group (brace or iota form) or permute
-    pair spanning ICI groups of ``group_size`` consecutive devices."""
-    cross = intra = 0.0
-    for m in re.finditer(
-            r"= ?((?:\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)) ([a-z\-]+)\(",
-            hlo):
-        shape_s, op = m.group(1), m.group(2)
-        if op not in _COLLECTIVES:
-            continue
-        line = hlo[m.start():hlo.index("\n", m.start())]
-        nbytes = 0
-        for sm in re.finditer(r"([a-z0-9]+)\[([0-9,]*)\]", shape_s):
-            dt, dims = sm.group(1), sm.group(2)
-            if dt not in _DT:
-                continue
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            nbytes += n * _DT[dt]
-        is_cross = False
-        rg = re.search(r"replica_groups=\{(\{[0-9,\}\{]*\})\}", line)
-        if rg:
-            for grp in re.findall(r"\{([0-9,]+)\}", rg.group(1)):
-                ids = [int(x) for x in grp.split(",")]
-                if len({i // group_size for i in ids}) > 1:
-                    is_cross = True
-                    break
-        ri = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
-                       r"(?:T\(([0-9,]+)\))?", line)
-        if ri:
-            ng, gs = int(ri.group(1)), int(ri.group(2))
-            dims = [int(x) for x in ri.group(3).split(",")]
-            arr = np.arange(int(np.prod(dims))).reshape(dims)
-            if ri.group(4):
-                arr = arr.transpose(
-                    [int(x) for x in ri.group(4).split(",")])
-            for ids in arr.reshape(ng, gs):
-                if len({int(i) // group_size for i in ids}) > 1:
-                    is_cross = True
-                    break
-        stp = re.search(r"source_target_pairs=\{([0-9,\{\}]*)\}", line)
-        if stp:
-            for pair in re.findall(r"\{([0-9]+),([0-9]+)\}", stp.group(1)):
-                if int(pair[0]) // group_size != int(pair[1]) // group_size:
-                    is_cross = True
-                    break
-        if is_cross:
-            cross += nbytes
-        else:
-            intra += nbytes
-    return cross, intra
 
 
 def _compiled_alexnet(machine8, strategy_file: str) -> str:
