@@ -71,6 +71,8 @@ def parse_args(argv) -> TransformerConfig:
             cfg._pipeline_stages = int(val())
         elif a == "--microbatches":
             cfg._microbatches = int(val())
+        elif a == "--pipeline-tp":
+            cfg._pipeline_tp = int(val())
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg
@@ -87,6 +89,26 @@ def synthetic_lm_batches(machine: MachineModel, batch_size: int,
         yield toks, toks
 
 
+def _per_op_tp(strategies, cfg) -> int:
+    """Stage-internal TP degree implied by a strategy file's per-op
+    entries, for pipeline blocks that predate the explicit "tp" field:
+    the head-axis split of ATTENTION entries' rank-3 grids
+    ("s", "h", "n") — identified by the op NAME (the LM builder names
+    them "blkN_attn"), because a bare grid is ambiguous (MoE grids are
+    also rank 3, ("e", "c", "n"), and an expert/capacity split must not
+    be misread as head TP).  Accepted when it divides the model's heads
+    and d_ff and every attention entry agrees; otherwise 1 (pure
+    PP x DP, the round-4 behavior)."""
+    splits = {pc.dims[1] for name, pc in strategies.items()
+              if "attn" in name and len(pc.dims) == 3 and pc.dims[1] > 1}
+    if len(splits) != 1:
+        return 1
+    tp = splits.pop()
+    if cfg.num_heads % tp or cfg.d_ff % tp:
+        return 1
+    return tp
+
+
 def _main_pipelined(cfg, machine, log) -> dict:
     """--pipeline-stages path: GPipe microbatch pipelining (PP x DP) of
     the block stack via parallel.pipeline.PipelinedLM."""
@@ -94,6 +116,7 @@ def _main_pipelined(cfg, machine, log) -> dict:
 
     from flexflow_tpu.parallel.pipeline import PipelinedLM
 
+    tp = getattr(cfg, "_pipeline_tp", 0) or 1
     model = PipelinedLM(
         machine, cfg._pipeline_stages,
         getattr(cfg, "_microbatches", 0) or cfg._pipeline_stages,
@@ -101,10 +124,12 @@ def _main_pipelined(cfg, machine, log) -> dict:
         num_heads=cfg.num_heads, d_ff=cfg.d_ff,
         vocab_size=cfg.vocab_size, seq_length=cfg.seq_length,
         batch_size=cfg.batch_size, causal=cfg.causal,
-        learning_rate=cfg.learning_rate, compute_dtype=cfg.compute_dtype)
+        learning_rate=cfg.learning_rate, compute_dtype=cfg.compute_dtype,
+        tp=tp)
     log(f"LM pipeline: {cfg.num_layers} layers over {model.S} stages x "
-        f"{machine.num_devices // model.S} dp, {model.M} microbatches, "
-        f"batch {cfg.batch_size}, seq {cfg.seq_length}")
+        f"{machine.num_devices // (model.S * model.tp)} dp x {model.tp} "
+        f"tp, {model.M} microbatches, batch {cfg.batch_size}, seq "
+        f"{cfg.seq_length}")
     params = model.init(cfg.seed)
     step = model.make_train_step()
     data = synthetic_lm_batches(machine, cfg.batch_size, cfg.seq_length,
@@ -146,10 +171,21 @@ def main(argv=None, log=print) -> dict:
         if pp and pp["stages"] > 1:
             cfg._pipeline_stages = pp["stages"]
             cfg._microbatches = pp["microbatches"]
+            # stage-internal TP (round 5, VERDICT r4 #5): the block's own
+            # tp if the searcher emitted one; otherwise derived from the
+            # file's per-op entries (the head-axis split of any 3-dim
+            # attention grid) — per-op TP entries now EXECUTE alongside
+            # the pipeline instead of being dropped
+            tp = int(pp.get("tp", 1) or 1)
+            if tp == 1:
+                tp = _per_op_tp(loaded_strategies, cfg)
+            cfg._pipeline_tp = tp
             cfg._strategy_file = ""
             log(f"pipeline block from {sf}: {pp['stages']} stages x "
-                f"{pp['microbatches']} microbatches (file-driven GPipe; "
-                f"per-op entries are advisory on this path)")
+                f"{pp['microbatches']} microbatches"
+                + (f" x tp={tp} (stage-internal TP from the strategy "
+                   f"file)" if tp > 1 else "")
+                + " (file-driven GPipe)")
         elif pp:
             # a hand-edited stages<=1 block would previously clear the
             # strategy file and then fail the >1 gate below — silently

@@ -29,7 +29,7 @@ def parse_args(argv):
         "model": "alexnet", "devices": None, "iters": 250_000,
         "out": "", "measured": False, "batch_size": 64, "seed": 0,
         "ici_group": None, "cache": "", "audit": None,
-        "dtype": "float32",
+        "dtype": "float32", "dcn_calibration": "", "experts": 0,
     }
     from flexflow_tpu.utils.flags import flag_stream
 
@@ -61,11 +61,18 @@ def parse_args(argv):
             # the searched plan's consuming driver may train bf16 — the
             # pipeline boundary-byte pricing follows this (VERDICT r4 #5)
             opts["dtype"] = val()
+        elif a == "--dcn-calibration":
+            # measured DCN-tier constants (utils/dcn_probe.py artifact)
+            # replace the modeled Topology defaults (VERDICT r4 #6)
+            opts["dcn_calibration"] = val()
+        elif a == "--experts":
+            # MoE transformer search (round 5: measured EP/TP costs)
+            opts["experts"] = int(val())
     return opts
 
 
 def build_model(name: str, machine: MachineModel, batch_size: int,
-                dtype: str = "float32"):
+                dtype: str = "float32", experts: int = 0):
     if name == "nmt":
         from flexflow_tpu.nmt.rnn_model import RnnConfig, RnnModel
 
@@ -76,7 +83,8 @@ def build_model(name: str, machine: MachineModel, batch_size: int,
                                                      TransformerLM)
 
         return TransformerLM(TransformerConfig(batch_size=batch_size,
-                                               compute_dtype=dtype),
+                                               compute_dtype=dtype,
+                                               num_experts=experts),
                              machine)
     from flexflow_tpu.apps.cnn import _builders
 
@@ -106,7 +114,7 @@ def _audit_strategy(strategy, opts, machine, dp_known=None):
             opts["model"], machine.num_devices,
             machine.topology.devices_per_ici_group, path,
             opts["batch_size"], timeout=1800.0, dtype=opts["dtype"],
-            dp_known=dp_known)
+            dp_known=dp_known, experts=opts.get("experts", 0))
     finally:
         os.unlink(path)
 
@@ -191,16 +199,24 @@ def main(argv=None, log=print) -> dict:
 
     if opts["devices"]:
         ici = opts["ici_group"] or opts["devices"]
-        machine = MachineModel.virtual(
-            opts["devices"], Topology(devices_per_ici_group=ici))
+        if opts["dcn_calibration"]:
+            topo = Topology.from_calibration(
+                opts["dcn_calibration"], devices_per_ici_group=ici)
+        else:
+            topo = Topology(devices_per_ici_group=ici)
+        machine = MachineModel.virtual(opts["devices"], topo)
     else:
         machine = MachineModel()
         if opts["ici_group"]:
-            machine.topology = Topology(
-                devices_per_ici_group=opts["ici_group"])
+            machine.topology = (
+                Topology.from_calibration(
+                    opts["dcn_calibration"],
+                    devices_per_ici_group=opts["ici_group"])
+                if opts["dcn_calibration"]
+                else Topology(devices_per_ici_group=opts["ici_group"]))
 
     model = build_model(opts["model"], machine, opts["batch_size"],
-                        opts["dtype"])
+                        opts["dtype"], opts["experts"])
 
     cost_model = None
     if opts["measured"]:
@@ -251,10 +267,13 @@ def main(argv=None, log=print) -> dict:
         # the best NON-pipelined plan (it replaces the per-op entries in
         # the consuming driver).  NMT is excluded: no NMT driver consumes
         # the block (PipelinedLM is a transformer stack).
+        import math as _math
+
         pp = search.propose_pipeline(
             log=log, reference_s=info["best_time"],
             stage_divisor=model.t.num_layers,
-            batch=model.t.batch_size)
+            batch=model.t.batch_size,
+            tp_divisor=_math.gcd(model.t.num_heads, model.t.d_ff))
         result["pipeline"] = {
             "accepted": pp["accepted"], "best": pp["best"],
             "reference_time_s": pp["reference_time_s"]}

@@ -40,6 +40,83 @@ from flexflow_tpu.strategy import ParallelConfig, validate_strategy
 from flexflow_tpu.utils.debug import print_tensor
 
 
+def _point_shape(shape, spec, sizes):
+    """Shape of one grid point's slice of a ``shape``-d leaf under a
+    single-axis PartitionSpec (the set-family residency layout)."""
+    entries = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    return tuple(s // (sizes.get(e, 1) if e is not None else 1)
+                 for s, e in zip(shape, entries))
+
+
+def _point_rows(tree, reg):
+    """(N, *point_shape) per-device rows of ``tree``'s leaves per a
+    set-family residency record — each named device's row holds the
+    slice its grid point computes with (shared by init's param/state
+    storage and _restack_state)."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.ops.base import point_slice
+    from flexflow_tpu.parallel.placement import grid_index
+
+    sizes = dict(zip(reg["axes"], reg["dims"]))
+    out = {}
+    for k, v in tree.items():
+        spec = reg["specs"][k]
+        pshape = _point_shape(tuple(v.shape), spec, sizes)
+        arr = jnp.zeros((reg["N"],) + pshape, v.dtype)
+        for j, dev in enumerate(reg["row"]):
+            arr = arr.at[dev].set(point_slice(
+                v, spec, sizes,
+                grid_index(j, reg["dims"], reg["axes"])))
+        out[k] = arr
+    return out
+
+
+def _point_row_avals(tree, reg, shardings):
+    """Abstract (ShapeDtypeStruct) counterpart of :func:`_point_rows`."""
+    import jax
+
+    sizes = dict(zip(reg["axes"], reg["dims"]))
+    return {k: jax.ShapeDtypeStruct(
+        (reg["N"],) + _point_shape(tuple(v.shape), reg["specs"][k],
+                                   sizes),
+        v.dtype, sharding=shardings[k]) for k, v in tree.items()}
+
+
+def _registry_match(rec, m, entry, j, g) -> bool:
+    """Does residency record ``rec`` describe member ``m`` at position
+    ``j`` (slot ``g``) of placement group ``entry``?  Gates the
+    prestacked fast path for params and state alike — a mismatched
+    record (different schedule variant) falls back to member-view
+    reassembly."""
+    if not rec or rec["dims"] != m.pc.dims:
+        return False
+    if entry.device_rows is not None:
+        return (rec.get("family") == "set"
+                and rec["row"] == tuple(entry.device_rows[j]))
+    return (rec.get("family", "block") == "block"
+            and rec.get("slot") == g
+            and rec["strided"] == entry.strided)
+
+
+def _fully_partitioned(op) -> bool:
+    """True when every param leaf of ``op`` is sharded over EVERY
+    nontrivial axis of its grid — i.e. the per-point slices are disjoint
+    (no replicated copies).  The eligibility bar for set-family
+    block-resident storage (see _derive_block_params)."""
+    sizes = dict(zip(op.AXIS_NAMES, op.pc.dims))
+    for spec in op.param_specs().values():
+        present = set()
+        for e in tuple(spec):
+            if e is None:
+                continue
+            present.update((e,) if isinstance(e, str) else e)
+        for a, s in sizes.items():
+            if s > 1 and a not in present:
+                return False
+    return True
+
+
 class FFModel:
     def __init__(self, config: Optional[FFConfig] = None,
                  machine: Optional[MachineModel] = None):
@@ -275,7 +352,16 @@ class FFModel:
                         # deterministic all-ones weights, hand-checkable runs
                         p = {k: jnp.ones_like(v) for k, v in p.items()}
                 bp = getattr(self, "_block_params", {}).get(op.param_key)
-                if p and bp:
+                if p and bp and bp.get("family") == "set":
+                    # set-family residency (round 5): per-device POINT
+                    # rows (N, *point_shape) on the flat mesh — device
+                    # row[j] holds the slice grid point j computes with
+                    sh = self._block_sharding(bp)
+                    params[op.param_key] = _point_row_avals(p, bp, sh) \
+                        if abstract else \
+                        {k: jax.device_put(v, sh[k])
+                         for k, v in _point_rows(p, bp).items()}
+                elif p and bp:
                     # block-resident storage (see _derive_block_params):
                     # stacked (G, ...) with the op's row live, sharded
                     # over the placement mesh so each block holds only
@@ -313,7 +399,34 @@ class FFModel:
                         }
             s = op.init_state()  # state is per-op even under shared params
             if s:
-                if abstract:
+                bs = getattr(self, "_block_state", {}).get(op.name)
+                if bs and bs.get("family") == "set":
+                    # per-device point rows, like set-family params
+                    sh = self._block_sharding(bs)
+                    state[op.name] = _point_row_avals(s, bs, sh) \
+                        if abstract else \
+                        {k: jax.device_put(v, sh[k])
+                         for k, v in _point_rows(s, bs).items()}
+                elif bs:
+                    # block-resident state (round 5, VERDICT r4 #9):
+                    # stacked (G, ...) with the op's row live, sharded
+                    # over the placement mesh like its params
+                    G, slot = bs["G"], bs["slot"]
+                    sh = self._block_sharding(bs)
+                    if abstract:
+                        state[op.name] = {
+                            k: jax.ShapeDtypeStruct(
+                                (G,) + tuple(v.shape), v.dtype,
+                                sharding=sh[k])
+                            for k, v in s.items()}
+                    else:
+                        state[op.name] = {
+                            k: jax.device_put(
+                                jnp.zeros((G,) + tuple(v.shape),
+                                          v.dtype).at[slot].set(v),
+                                sh[k])
+                            for k, v in s.items()}
+                elif abstract:
                     state[op.name] = jax.tree.map(
                         lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), s)
                 else:
@@ -367,16 +480,27 @@ class FFModel:
 
     def _constrain_state(self, new_state):
         """Pin updated per-op state (e.g. BatchNorm running stats) to the
-        replicated sharding init() committed it with — same retrace hazard
-        as _constrain_params, via the state output."""
+        sharding init() committed it with — replicated, or the stacked
+        block-resident layout for registered group members — same retrace
+        hazard as _constrain_params, via the state output."""
         import jax
         from jax import lax
 
         if not new_state:
             return new_state
         repl = self.machine.replicated()
-        return jax.tree.map(
-            lambda v: lax.with_sharding_constraint(v, repl), new_state)
+        block_state = getattr(self, "_block_state", {})
+        out = {}
+        for name, st in new_state.items():
+            bs = block_state.get(name)
+            if bs:
+                sh = self._block_sharding(bs)
+                out[name] = {k: lax.with_sharding_constraint(v, sh[k])
+                             for k, v in st.items()}
+            else:
+                out[name] = jax.tree.map(
+                    lambda v: lax.with_sharding_constraint(v, repl), st)
+        return out
 
     # ------------------------------------------------------------------
     # execution
@@ -521,7 +645,8 @@ class FFModel:
         self._honored_pcs = pcs
         self._sched_cache = (exclude, sched)
         if exclude == frozenset() and not hasattr(self, "_block_params"):
-            self._block_params = self._derive_block_params(sched)
+            self._block_params, self._block_state = \
+                self._derive_block_params(sched)
         return sched
 
     def _derive_block_params(self, sched):
@@ -551,11 +676,50 @@ class FFModel:
         for op in self.layers:
             uses[op.param_key] = uses.get(op.param_key, 0) + 1
         out = {}
+        state_out: Dict[str, dict] = {}
         for entry in sched:
             if not isinstance(entry, PlacementGroup):
                 continue
             if entry.device_rows is not None:
-                continue  # set family replicates operands by design
+                # set family (round 5, VERDICT r4 #3): params stored as
+                # per-device POINT rows (N, *point_shape) sharded over
+                # the flat mesh — each named device holds exactly the
+                # param slice its grid point computes with, so an
+                # irregular-set group no longer re-streams its member
+                # params (across DCN on a two-tier machine) every step.
+                # SOUNDNESS GATE: every leaf must be FULLY partitioned
+                # across the grid (each nontrivial grid axis appears in
+                # its spec).  A leaf replicated over some axis (e.g. a
+                # batch-split linear's kernel) would store independent
+                # per-point COPIES whose gradients never cross-sum on
+                # the flat mesh (no live grid axes for the shard_map
+                # transpose), silently diverging the replicas — the
+                # block family is immune (its inner mesh axes are live
+                # inside the group shard_map).
+                for j, m in enumerate(entry.members):
+                    if (uses.get(m.param_key) == 1 and m.param_specs()
+                            and not isinstance(m, RnnLinear)
+                            and _fully_partitioned(m)):
+                        out[m.param_key] = {
+                            "family": "set",
+                            "row": tuple(entry.device_rows[j]),
+                            "dims": m.pc.dims, "axes": m.AXIS_NAMES,
+                            "N": self.machine.num_devices,
+                            "specs": m.param_specs()}
+                    # stateful set members (round 5: BatchNorm via its
+                    # global-stats point_forward): state stored as
+                    # per-device point rows like params.  No
+                    # full-partitioning gate needed — state WRITES are
+                    # deterministic per point (no gradient summing), so
+                    # replicated rows stay consistent by construction
+                    if m.init_state() and m.state_specs() is not None:
+                        state_out[m.name] = {
+                            "family": "set",
+                            "row": tuple(entry.device_rows[j]),
+                            "dims": m.pc.dims, "axes": m.AXIS_NAMES,
+                            "N": self.machine.num_devices,
+                            "specs": m.state_specs()}
+                continue
             # homogeneous AND hetero groups qualify (round 4): the hetero
             # runner ravels each member's row slice into its group-vector
             # slot, which stays on the member's block
@@ -563,19 +727,42 @@ class FFModel:
                 if (uses.get(m.param_key) == 1 and m.param_specs()
                         and not isinstance(m, RnnLinear)):
                     out[m.param_key] = {
+                        "family": "block",
                         "slot": g, "dims": m.pc.dims,
                         "axes": m.AXIS_NAMES, "strided": entry.strided,
                         "G": entry.n_groups,
                         "specs": m.param_specs()}
-        return out
+                # state residency (round 5, VERDICT r4 #9): a stateful
+                # member's state is stored the same stacked (G, ...)
+                # way as its params — the runner merges rows by one-hot
+                # masks and returns the member's row masked in place,
+                # so no state byte crosses the group axis per step
+                # (previously state entered replicated and was
+                # re-stacked every step — the params gap at small
+                # scale)
+                if m.init_state() and m.state_specs() is not None:
+                    state_out[m.name] = {
+                        "family": "block",
+                        "slot": g, "dims": m.pc.dims,
+                        "axes": m.AXIS_NAMES, "strided": entry.strided,
+                        "G": entry.n_groups,
+                        "specs": m.state_specs()}
+        return out, state_out
 
     def _block_sharding(self, bp):
         """{param name: NamedSharding} of one block-resident registry
-        entry — the single source of truth for the stacked (G, ...)
-        layout used by init() and _param_shardings()."""
+        entry — the single source of truth for the stacked layout used
+        by init() and _param_shardings().  Block/stride family: (G, ...)
+        over the placement mesh's group axis.  Set family (round 5):
+        (N, *point_shape) over the flat ``(_dev,)`` mesh — one point row
+        per device."""
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
+        if bp.get("family") == "set":
+            mesh = self.machine.flat_mesh()
+            return {k: NamedSharding(mesh, P("_dev"))
+                    for k in bp["specs"]}
         mesh = self.machine.placement_mesh(bp["dims"], bp["axes"],
                                            strided=bp["strided"])
         return {k: NamedSharding(mesh, P("_pg", *spec))
@@ -583,15 +770,62 @@ class FFModel:
 
     def _member_params(self, params, op):
         """The op's param tree as ITS code expects it — block-resident
-        keys are stored stacked (G, ...), so unplaced execution paths
-        (single-op schedule entries, dump mode) slice the op's row."""
+        keys are stored stacked (G, ...) (block/stride) or as per-device
+        point rows (N, *point) (set family), so unplaced execution paths
+        (single-op schedule entries, dump mode) reassemble the op's full
+        tree."""
         p = params.get(op.param_key, {})
         bp = getattr(self, "_block_params", {}).get(op.param_key)
         if bp and p:
             import jax
 
-            p = jax.tree.map(lambda l: l[bp["slot"]], p)
+            if bp.get("family") == "set":
+                from flexflow_tpu.parallel.placement import _assemble
+
+                sizes = dict(zip(bp["axes"], bp["dims"]))
+                p = {k: _assemble([l[d] for d in bp["row"]],
+                                  bp["specs"][k], sizes, bp["axes"],
+                                  bp["dims"])
+                     for k, l in p.items()}
+            else:
+                p = jax.tree.map(lambda l: l[bp["slot"]], p)
         return p
+
+    def _member_state(self, state, op):
+        """The op's state tree as ITS code expects it — block-resident
+        state (see _derive_block_params) is stored stacked (G, ...)
+        (block/stride) or as per-device point rows (set), so unplaced
+        execution paths reassemble the op's tree."""
+        st = state.get(op.name, {})
+        bs = getattr(self, "_block_state", {}).get(op.name)
+        if bs and st:
+            import jax
+
+            if bs.get("family") == "set":
+                from flexflow_tpu.parallel.placement import _assemble
+
+                sizes = dict(zip(bs["axes"], bs["dims"]))
+                st = {k: _assemble([l[d] for d in bs["row"]],
+                                   bs["specs"][k], sizes, bs["axes"],
+                                   bs["dims"])
+                      for k, l in st.items()}
+            else:
+                st = jax.tree.map(lambda l: l[bs["slot"]], st)
+        return st
+
+    def _restack_state(self, op, st):
+        """Inverse of _member_state for the unplaced path: new state from
+        a plain forward returns to the block-resident storage layout."""
+        bs = getattr(self, "_block_state", {}).get(op.name)
+        if not bs or not st:
+            return st
+        import jax.numpy as jnp
+
+        if bs.get("family") == "set":
+            return _point_rows(st, bs)
+        G, slot = bs["G"], bs["slot"]
+        return {k: jnp.zeros((G,) + v.shape, v.dtype).at[slot].set(v)
+                for k, v in st.items()}
 
     def _honored_ctx(self):
         return self.machine.honored_placements(
@@ -647,23 +881,44 @@ class FFModel:
         for entry in schedule:
             if isinstance(entry, PlacementGroup):
                 block = getattr(self, "_block_params", {})
-                pre = [block.get(m.param_key, {}).get("slot") == g
-                       and block[m.param_key]["dims"] == m.pc.dims
-                       and block[m.param_key]["strided"] == entry.strided
-                       for m, g in zip(entry.members, entry.slots)]
+                block_state = getattr(self, "_block_state", {})
+                pre = [_registry_match(block.get(m.param_key), m, entry,
+                                       j, g)
+                       for j, (m, g) in
+                       enumerate(zip(entry.members, entry.slots))]
+                spre = [_registry_match(block_state.get(m.name), m,
+                                        entry, j, g)
+                        for j, (m, g) in
+                        enumerate(zip(entry.members, entry.slots))]
                 outs_by_member, states_by_member = run_group(
                     self.machine, entry,
                     [params.get(m.param_key, {}) if pre[j] else
                      self._member_params(params, m)
                      for j, m in enumerate(entry.members)],
-                    [[values[t.tid] for t in m.inputs]
+                    [self._regrid_group_inputs(
+                        entry, m, [values[t.tid] for t in m.inputs],
+                        specs) if multi else
+                     [values[t.tid] for t in m.inputs]
                      for m in entry.members], train,
-                    [state.get(m.name, {}) for m in entry.members],
-                    prestacked=pre)
+                    [state.get(m.name, {}) if spre[j] else
+                     self._member_state(state, m)
+                     for j, m in enumerate(entry.members)],
+                    prestacked=pre, state_prestacked=spre)
                 for m, outs, st in zip(entry.members, outs_by_member,
                                        states_by_member):
-                    for t, y in zip(m.all_outputs(), outs):
+                    for t, y, spec in zip(m.all_outputs(), outs,
+                                          m.output_specs()):
                         values[t.tid] = y
+                        # record the exit layout (run_group constrained
+                        # each member output to its pc's normalized
+                        # sharding, which lives on the global mesh when
+                        # the grid decomposes) so downstream
+                        # _regrid_inputs can decompose the jump into
+                        # single-axis hops instead of letting GSPMD
+                        # full-rematerialize it (round 5)
+                        if multi and spec is not None:
+                            specs[t.tid] = self.machine.global_entries(
+                                m.pc, m.AXIS_NAMES, spec, rank=t.ndim)
                     if st:
                         new_state[m.name] = st
                 continue
@@ -682,7 +937,9 @@ class FFModel:
             if multi:
                 xs = self._regrid_inputs(op, xs, specs)
             res, st = op.forward(self._member_params(params, op),
-                                 state.get(op.name, {}), xs, train)
+                                 self._member_state(state, op), xs, train)
+            if st:
+                st = self._restack_state(op, st)
             ys = res if isinstance(res, tuple) else (res,)
             for t, y, spec in zip(op.all_outputs(), ys, op.output_specs()):
                 if multi and spec is not None:
@@ -696,6 +953,42 @@ class FFModel:
             if st:
                 new_state[op.name] = st
         return values, new_state
+
+    def _regrid_group_inputs(self, entry, m, xs, specs):
+        """Decomposed resharding for a placement-group member's inputs
+        (round 5).  Group inputs bypass ``_regrid_inputs`` and meet the
+        group shard_map's in_specs directly; when the producer's layout
+        is known on the global mesh, walk there in single-axis hops
+        exactly like the single-op path — a spatial-grid producer
+        feeding a batch-grid group otherwise triggers GSPMD's
+        involuntary full rematerialization at the shard_map boundary.
+        Set-family members consume REPLICATED operands (the per-device
+        dispatch contract), so their target is the all-axes-dropped
+        layout."""
+        from jax import lax
+
+        if entry.device_rows is not None:
+            targets = [tuple(() for _ in range(t.ndim)) for t in m.inputs]
+        else:
+            ins = m.input_specs()
+            if ins is None:
+                return xs
+            targets = [self.machine.global_entries(m.pc, m.AXIS_NAMES,
+                                                   spec, rank=t.ndim)
+                       for spec, t in zip(ins, m.inputs)]
+        out = []
+        for x, t, dst in zip(xs, m.inputs, targets):
+            src = specs.get(t.tid)
+            if dst is None or src is None or dst == src:
+                out.append(x)
+                continue
+            for step in self.machine.regrid_steps(src, dst) or []:
+                x = lax.with_sharding_constraint(
+                    x, self.machine.entries_sharding(step))
+            x = lax.with_sharding_constraint(
+                x, self.machine.entries_sharding(dst))
+            out.append(x)
+        return out
 
     def _regrid_inputs(self, op, xs, specs):
         """Re-shard ``op``'s inputs to the layout its compute wants, as a
@@ -725,6 +1018,14 @@ class FFModel:
                 for step in self.machine.regrid_steps(src, dst) or []:
                     x = lax.with_sharding_constraint(
                         x, self.machine.entries_sharding(step))
+            else:
+                # unknown producer layout (a placement-group exit whose
+                # grid does not decompose onto the global mesh): GSPMD's
+                # only general lowering to ``dst`` is replicate-then-
+                # slice — state the waypoint so the identical program
+                # compiles without the involuntary-remat warning
+                x = lax.with_sharding_constraint(
+                    x, self.machine.replicated())
             x = lax.with_sharding_constraint(
                 x, self.machine.entries_sharding(dst))
             out.append(x)

@@ -51,6 +51,24 @@ class Tensor:
         return f"Tensor(name={self.name!r}, shape={self.shape}, from={p})"
 
 
+def point_slice(arr, spec, sizes, idx):
+    """Static slice of one grid point's block of ``arr`` per its
+    PartitionSpec (single-axis-or-None entries — the set-family
+    eligibility bar, parallel/placement.py _set_eligible).  ``sizes``
+    maps axis name -> parts, ``idx`` maps axis name -> this point's
+    index."""
+    entries = tuple(spec) + (None,) * (arr.ndim - len(tuple(spec)))
+    sl = []
+    for d, e in enumerate(entries):
+        parts = sizes.get(e, 1) if e is not None else 1
+        if parts == 1:
+            sl.append(slice(None))
+        else:
+            n = arr.shape[d] // parts
+            sl.append(slice(idx[e] * n, (idx[e] + 1) * n))
+    return arr[tuple(sl)]
+
+
 def exchange_halo(x, axis_name: str, parts: int, k: int, dim: int):
     """Borrow the (k-1)/2 edge rows of each neighbor along mesh axis
     ``axis_name`` via ppermute and concatenate them onto tensor dim
@@ -184,6 +202,33 @@ class Op:
         cls = type(self)
         return (cls.placed_prelude is Op.placed_prelude
                 and cls.sharded_forward is Op.sharded_forward)
+
+    def point_placeable(self) -> bool:
+        """Can this op execute as per-device grid POINTS in a set-family
+        placement group (parallel/placement.py _run_group_set)?  The
+        runner replicates operands, so a point computes from the FULL
+        inputs — an op overriding :meth:`point_forward` may slice
+        arbitrary windows (halos WITHOUT collectives, round 5: the full
+        input is available on every device, so the neighbor exchange
+        that gates block/stride spatial placement is just a static
+        slice here).  Default: the point-local bar (the round-4
+        behavior)."""
+        return self.placed_local()
+
+    def point_forward(self, params, state, xs, idx, sizes, train):
+        """One grid point's computation from FULL (replicated) operands:
+        slice + compute, returning ``(tuple of this point's output
+        blocks, new state dict)``.  ``params`` (and ``state``) arrive
+        already point-sliced; ``idx``/``sizes`` map axis name -> point
+        index / parts.  Default: point-slice the inputs by input_specs
+        and run the plain forward — correct for point-local ops; ops
+        with neighborhood dependencies (spatial conv/pool) override to
+        slice halo windows, stateful ops (BatchNorm) to compute global
+        statistics from the full input."""
+        xs_pt = [point_slice(x, s, sizes, idx)
+                 for x, s in zip(xs, self.input_specs())]
+        res, new_state = self.forward(params, state, xs_pt, train)
+        return (res if isinstance(res, tuple) else (res,)), new_state
 
     def state_specs(self):
         """PartitionSpec per state leaf for PLACED execution (state

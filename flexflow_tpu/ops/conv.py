@@ -131,6 +131,45 @@ class Conv2D(Op):
         pw, ph, _pc, _pn = self.pc.dims
         return pw == 1 and ph == 1
 
+    def point_placeable(self) -> bool:
+        # Set-family per-device dispatch replicates the input, so halo
+        # rows are STATIC slices of the full tensor — every spatial grid
+        # qualifies, any stride/kernel/padding (round 5, widening the
+        # block/stride bar of SAME/stride-1 only; the reference ran any
+        # conv on any named GPU, nmt/rnn_mapper.cc:28-41).  Divisibility
+        # of the assembled output is checked by _set_eligible.
+        return True
+
+    def point_forward(self, params, state, xs, idx, sizes, train):
+        """One spatial/channel/batch grid point from the FULL input: pad
+        once (the conv's own zero padding), slice the fixed-size halo
+        window for this point's output tile, convolve VALID.  Identical
+        window sizes across points keep the per-device switch's avals
+        equal."""
+        import jax.numpy as jnp
+
+        (x,) = xs
+        n, oh, ow, _ = self.output.shape
+        pn, pcc = sizes.get("n", 1), sizes.get("c", 1)
+        ph, pw = sizes.get("h", 1), sizes.get("w", 1)
+        if pn > 1:
+            bs = n // pn
+            x = x[idx["n"] * bs:(idx["n"] + 1) * bs]
+        if ph > 1 or pw > 1:
+            x = jnp.pad(x, ((0, 0), (self.padding_h, self.padding_h),
+                            (self.padding_w, self.padding_w), (0, 0)))
+            oh_l, ow_l = oh // ph, ow // pw
+            h0 = idx["h"] * oh_l * self.stride_h
+            hl = (oh_l - 1) * self.stride_h + self.kernel_h
+            w0 = idx["w"] * ow_l * self.stride_w
+            wl = (ow_l - 1) * self.stride_w + self.kernel_w
+            x = x[:, h0:h0 + hl, w0:w0 + wl, :]
+            pad_h = pad_w = 0
+        else:
+            pad_h, pad_w = self.padding_h, self.padding_w
+        del pcc  # params arrive already c-sliced (kernel/bias over 'c')
+        return (self._conv_bias_relu(params, x, pad_h, pad_w),), {}
+
     def regrid_input_specs(self):
         from jax.sharding import PartitionSpec as P
 

@@ -84,6 +84,44 @@ class BatchNorm(Op):
         # placed grid never splits c — input_specs rejects that)
         return {"mean": P(), "var": P()}
 
+    def point_placeable(self) -> bool:
+        # Set-family dispatch replicates the input, so GLOBAL batch
+        # statistics need no collective — any batch/spatial grid
+        # qualifies (round 5, closing the "BatchNorm on an irregular
+        # list silently normalizes" gap).  c stays unsplit, matching
+        # input_specs' reasoning (running stats shard with c).
+        return self.pc.dims[2] == 1
+
+    def point_forward(self, params, state, xs, idx, sizes, train):
+        """One grid point from the FULL input: compute global batch
+        statistics directly (every device holds the whole batch — the
+        canonical semantics with zero collectives), update the running
+        stats, normalize, and slice this point's output block."""
+        import jax
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.base import point_slice
+
+        (x,) = xs
+        if train:
+            xf = x.astype("float32")
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            var = jnp.var(xf, axis=(0, 1, 2))
+            m = self.momentum
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean,
+                         "var": m * state["var"] + (1 - m) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = dict(state)
+        inv = jax.lax.rsqrt(var + self.eps) * params["scale"]
+        shift = params["bias"] - mean * inv
+        y = x * inv.astype(x.dtype) + shift.astype(x.dtype)
+        if self.relu:
+            y = jax.nn.relu(y)
+        # the point's block: the slice fuses into the elementwise chain
+        y = point_slice(y, self.output_spec(), sizes, idx)
+        return (y,), new_state
+
     def placed_prelude(self, xs, train: bool):
         """Batch statistics over the WHOLE placed block, not the local
         shard: lax.pmean over the live grid axes keeps the framework

@@ -142,6 +142,61 @@ class Pool2D(Op):
         pw, ph, _pc, _pn = self.pc.dims
         return pw == 1 and ph == 1
 
+    def point_placeable(self) -> bool:
+        # Set-family dispatch computes each point from the FULL
+        # (replicated) input: halo rows are static slices, boundary
+        # semantics are exact via fill values (-inf for MAX — lifting
+        # the block/stride families' AVG-only restriction — zeros +
+        # validity count for AVG).  Any stride/kernel/padding.
+        return True
+
+    def point_forward(self, params, state, xs, idx, sizes, train):
+        """One grid point from the full input: pad with the pool's
+        neutral fill, slice the fixed-size halo window, reduce VALID.
+        AVG divides by the count of valid (un-padded) positions —
+        identical to the canonical forward's semantics."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        (x,) = xs
+        _, oh, ow, _ = self.output.shape
+        pn, pcc = sizes.get("n", 1), sizes.get("c", 1)
+        ph, pw = sizes.get("h", 1), sizes.get("w", 1)
+        if pn > 1:
+            bs = x.shape[0] // pn
+            x = x[idx["n"] * bs:(idx["n"] + 1) * bs]
+        if pcc > 1:
+            cs = x.shape[3] // pcc
+            x = x[..., idx["c"] * cs:(idx["c"] + 1) * cs]
+        if ph == 1 and pw == 1:
+            res, _ = self.forward(params, {}, [x], train)
+            return (res,), {}
+        pads2 = ((0, 0), (self.padding_h, self.padding_h),
+                 (self.padding_w, self.padding_w), (0, 0))
+        fill = -jnp.inf if self.pool_type == POOL_MAX else 0.0
+        ones = jnp.pad(jnp.ones_like(x), pads2)
+        x = jnp.pad(x, pads2, constant_values=fill)
+        oh_l, ow_l = oh // ph, ow // pw
+        h0 = idx["h"] * oh_l * self.stride_h
+        hl = (oh_l - 1) * self.stride_h + self.kernel_h
+        w0 = idx["w"] * ow_l * self.stride_w
+        wl = (ow_l - 1) * self.stride_w + self.kernel_w
+        x = x[:, h0:h0 + hl, w0:w0 + wl, :]
+        ones = ones[:, h0:h0 + hl, w0:w0 + wl, :]
+        window = (1, self.kernel_h, self.kernel_w, 1)
+        strides = (1, self.stride_h, self.stride_w, 1)
+        vp = ((0, 0),) * 4
+        if self.pool_type == POOL_MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, vp)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, vp)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, vp)
+            y = s / cnt
+        if self.relu:
+            y = jax.nn.relu(y)
+        return (y,), {}
+
     def regrid_input_specs(self):
         from jax.sharding import PartitionSpec as P
 

@@ -45,7 +45,8 @@ def microbatch(x, num_microbatches: int):
 
 def spmd_pipeline(stage_fn: Callable, stage_params, xs, mesh: Mesh,
                   stage_axis: str = "stage",
-                  batch_spec: Optional[P] = None):
+                  batch_spec: Optional[P] = None,
+                  param_specs=None):
     """Run microbatches through a homogeneous pipeline of S stages.
 
     stage_fn(params_one_stage, x_mb) -> y_mb; activations must keep the
@@ -56,6 +57,12 @@ def spmd_pipeline(stage_fn: Callable, stage_params, xs, mesh: Mesh,
     batch_spec: PartitionSpec of one microbatch's data dims (after the
     leading M axis), e.g. P("n") to shard the microbatch over a data
     axis; defaults to fully replicated.
+    param_specs: optional pytree (matching stage_params) of per-leaf
+    PartitionSpecs — round 5: stage params may be TENSOR-PARALLEL within
+    each stage's submesh (leaf dims sharded over e.g. a "tp" axis in
+    addition to the leading stage axis); stage_fn then runs with those
+    axes live and inserts its own psums.  Default: every leaf
+    P(stage_axis) (stage-stacked, otherwise replicated).
 
     Returns (M, mb, ...) outputs, replicated over ``stage_axis``.
     """
@@ -80,7 +87,8 @@ def spmd_pipeline(stage_fn: Callable, stage_params, xs, mesh: Mesh,
                 f"one stage slice")
     data_spec = batch_spec if batch_spec is not None else P()
     xs_spec = P(None, *data_spec)   # leading M axis never sharded
-    param_spec = P(stage_axis)      # leading stage-stack axis
+    param_spec = param_specs if param_specs is not None \
+        else jax.tree.map(lambda _: P(stage_axis), stage_params)
 
     def pipelined(params, xs_local):
         local_params = jax.tree.map(lambda p: p[0], params)
@@ -141,48 +149,73 @@ def _layer_norm(g, b, x, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * g + b
 
 
-def transformer_block_fn(num_heads: int, causal: bool = False):
+def transformer_block_fn(num_heads: int, causal: bool = False,
+                         tp_axis: Optional[str] = None):
     """A pre-norm transformer block as a pipeline stage_fn.  Params:
-    {"ln1": (2, D), "wqkv": (D, 3D), "bqkv": (3D,), "wo": (D, D),
+    {"ln1": (2, D), "wqkv": (D, 3, D), "bqkv": (3, D), "wo": (D, D),
      "bo": (D,), "ln2": (2, D), "w1": (D, F), "b1": (F,), "w2": (F, D),
-     "b2": (D,)}."""
+     "b2": (D,)}.
+
+    Round 5 — stage-internal tensor parallelism: with ``tp_axis`` set
+    (a live mesh axis inside the pipeline shard_map) the block is
+    Megatron-sharded over it: wqkv/bqkv/w1/b1 column-split, wo/w2
+    row-split (see :func:`stage_param_specs`), each device computes its
+    head/ffn slice from the replicated activation, and the two partial
+    products psum over the axis.  With tp_axis=None the same code runs
+    the full block (the sequential reference path) — the local head
+    count is derived from the actual shard shapes, so one body serves
+    both."""
 
     def block(p, x):
         d = x.shape[-1]
+        head_dim = d // num_heads
         h = _layer_norm(p["ln1"][0], p["ln1"][1], x)
-        qkv = h @ p["wqkv"] + p["bqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (B, S, 3, E) where E = D/tp locally: q/k/v each get their own
+        # contiguous head subset (the (D, 3, D) layout keeps the three
+        # projections separable under a last-dim shard)
+        qkv = jnp.einsum("bsd,dte->bste", h, p["wqkv"]) + p["bqkv"]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-        def heads(t):  # (B, S, D) -> (B, H, S, d_h)
-            b_, s_, _ = t.shape
-            return t.reshape(b_, s_, num_heads, d // num_heads) \
+        def heads(t):  # (B, S, E) -> (B, H_local, S, d_h)
+            b_, s_, e_ = t.shape
+            return t.reshape(b_, s_, e_ // head_dim, head_dim) \
                     .transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        scale = 1.0 / jnp.sqrt(jnp.asarray(d // num_heads, x.dtype))
+        scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, x.dtype))
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
         if causal:
             mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
             s = jnp.where(mask, s, -jnp.inf)
         a = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
-        o = o.transpose(0, 2, 1, 3).reshape(x.shape)
-        x = x + (o @ p["wo"] + p["bo"])
+        b_, hl, s_, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b_, s_, hl * head_dim)
+        attn = o @ p["wo"]            # (E, D) row-shard -> partial sums
+        if tp_axis is not None:
+            attn = lax.psum(attn, tp_axis)
+        x = x + attn + p["bo"]
 
         h = _layer_norm(p["ln2"][0], p["ln2"][1], x)
         h = jax.nn.gelu(h @ p["w1"] + p["b1"])
-        return x + (h @ p["w2"] + p["b2"])
+        ffn = h @ p["w2"]             # (F/tp, D) row-shard -> partial
+        if tp_axis is not None:
+            ffn = lax.psum(ffn, tp_axis)
+        return x + ffn + p["b2"]
 
     return block
 
 
 def init_block_stack(rng, num_stages: int, d_model: int, d_ff: int):
-    """Stage-stacked transformer block params (leading axis = stage)."""
+    """Stage-stacked transformer block params (leading axis = stage).
+    wqkv is (D, 3, D) — the three projections on their own dim, so a
+    last-dim tensor-parallel shard splits each of q/k/v by heads instead
+    of slicing across the q|k|v concatenation."""
     ks = jax.random.split(rng, 4)
     shapes = {
         "ln1": ((2, d_model), None),
-        "wqkv": ((d_model, 3 * d_model), 0),
-        "bqkv": ((3 * d_model,), None),
+        "wqkv": ((d_model, 3, d_model), 0),
+        "bqkv": ((3, d_model), None),
         "wo": ((d_model, d_model), 1),
         "bo": ((d_model,), None),
         "ln2": ((2, d_model), None),
@@ -206,13 +239,37 @@ def init_block_stack(rng, num_stages: int, d_model: int, d_ff: int):
     return params
 
 
-def place_stage_params(params, mesh: Mesh, stage_axis: str = "stage"):
-    """Shard the stage-stacked params over the stage axis of ``mesh``."""
+def stage_param_specs(stage_axis: str = "stage",
+                      tp_axis: Optional[str] = None,
+                      sub_dims: int = 0):
+    """Per-leaf PartitionSpecs of the block stack: stage-stacked on the
+    leading axis, and (round 5) Megatron-sharded over ``tp_axis`` —
+    wqkv/bqkv/w1/b1 column-split (head/ffn slices), wo/w2 row-split
+    (partials psum in the block).  ``sub_dims`` extra None dims between
+    the stage axis and the param dims (PipelinedLM stacks (S, L/S, ...))."""
+    s = (stage_axis,) + (None,) * sub_dims
+    t = tp_axis
+    return {
+        "ln1": P(*s), "ln2": P(*s), "bo": P(*s), "b2": P(*s),
+        "wqkv": P(*s, None, None, t), "bqkv": P(*s, None, t),
+        "wo": P(*s, t, None), "w1": P(*s, None, t),
+        "b1": P(*s, t), "w2": P(*s, t, None),
+    }
+
+
+def place_stage_params(params, mesh: Mesh, stage_axis: str = "stage",
+                       param_specs=None):
+    """Shard the stage-stacked params over the stage axis of ``mesh``
+    (and any additional per-leaf axes in ``param_specs``)."""
+    if param_specs is None:
+        return jax.tree.map(
+            lambda p: jax.device_put(
+                p, NamedSharding(mesh, P(*((stage_axis,) +
+                                           (None,) * (p.ndim - 1))))),
+            params)
     return jax.tree.map(
-        lambda p: jax.device_put(
-            p, NamedSharding(mesh, P(*((stage_axis,) +
-                                       (None,) * (p.ndim - 1))))),
-        params)
+        lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
+        params, param_specs)
 
 
 # ----------------------------------------------------------------------
@@ -237,24 +294,28 @@ class PipelinedLM:
                  num_heads: int = 12, d_ff: int = 3072,
                  vocab_size: int = 32768, seq_length: int = 512,
                  batch_size: int = 16, causal: bool = True,
-                 learning_rate: float = 1e-3, compute_dtype="float32"):
+                 learning_rate: float = 1e-3, compute_dtype="float32",
+                 tp: int = 1):
         import numpy as np
 
         if num_layers % num_stages:
             raise ValueError(f"{num_layers} layers not divisible into "
                              f"{num_stages} stages")
-        if machine.num_devices % num_stages:
+        if machine.num_devices % (num_stages * tp):
             raise ValueError(f"{machine.num_devices} devices not divisible "
-                             f"into {num_stages} stages")
+                             f"into {num_stages} stages x {tp} tp")
+        if num_heads % tp or d_ff % tp:
+            raise ValueError(f"tp={tp} must divide num_heads ({num_heads}) "
+                             f"and d_ff ({d_ff})")
         if batch_size % num_microbatches:
             raise ValueError("batch not divisible by microbatches")
-        dp = machine.num_devices // num_stages
+        dp = machine.num_devices // (num_stages * tp)
         if (batch_size // num_microbatches) % dp:
             raise ValueError(
                 f"microbatch size {batch_size // num_microbatches} not "
                 f"divisible by the data-parallel axis ({dp} devices)")
         self.machine = machine
-        self.S, self.M = num_stages, num_microbatches
+        self.S, self.M, self.tp = num_stages, num_microbatches, tp
         self.L, self.D, self.H = num_layers, d_model, num_heads
         self.F, self.V = d_ff, vocab_size
         self.seq, self.batch = seq_length, batch_size
@@ -264,8 +325,13 @@ class PipelinedLM:
         dev = np.empty(machine.num_devices, object)
         for i, d in enumerate(machine.devices):
             dev[i] = d
-        self.mesh = Mesh(dev.reshape(num_stages, dp), ("stage", "n"))
-        self.block = transformer_block_fn(num_heads, causal)
+        # tp innermost: a stage's tp group is ICI-contiguous, its psums
+        # never cross a stage boundary (round 5 — stage-internal TP from
+        # the strategy file's pipeline block)
+        self.mesh = Mesh(dev.reshape(num_stages, dp, tp),
+                         ("stage", "n", "tp"))
+        self.block = transformer_block_fn(
+            num_heads, causal, tp_axis="tp" if tp > 1 else None)
 
     # -- params ---------------------------------------------------------
 
@@ -276,7 +342,8 @@ class PipelinedLM:
         blocks = jax.tree.map(
             lambda p: p.reshape((self.S, self.L // self.S) + p.shape[1:]),
             blocks)
-        blocks = place_stage_params(blocks, self.mesh)
+        blocks = place_stage_params(blocks, self.mesh,
+                                    param_specs=self._block_specs())
         repl = NamedSharding(self.mesh, P())
         scale = 1.0 / jnp.sqrt(jnp.asarray(self.D, "float32"))
         other = {
@@ -294,8 +361,13 @@ class PipelinedLM:
 
     # -- forward/loss ---------------------------------------------------
 
-    def _stage_fn(self):
-        block, n_sub, dtype = self.block, self.L // self.S, self.dtype
+    def _block_specs(self):
+        return stage_param_specs(
+            "stage", "tp" if self.tp > 1 else None, sub_dims=1)
+
+    def _stage_fn(self, block=None):
+        block = block or self.block
+        n_sub, dtype = self.L // self.S, self.dtype
 
         def stage(p, x):
             p = jax.tree.map(lambda q: q.astype(dtype), p)
@@ -333,14 +405,18 @@ class PipelinedLM:
     def loss_fn(self, params, tokens, labels):
         xs = microbatch(self._embed(params, tokens), self.M)
         ys = spmd_pipeline(self._stage_fn(), params["blocks"], xs,
-                           self.mesh, batch_spec=P("n"))
+                           self.mesh, batch_spec=P("n"),
+                           param_specs=self._block_specs())
         return self._head_loss(params, ys, labels)
 
     def loss_reference(self, params, tokens, labels):
-        """Same model WITHOUT the pipeline ring (sequential stages) —
-        pins the pipelined semantics in tests."""
+        """Same model WITHOUT the pipeline ring (sequential stages, full
+        unsharded math — no tp psums) — pins the pipelined semantics in
+        tests."""
         xs = microbatch(self._embed(params, tokens), self.M)
-        ys = sequential_reference(self._stage_fn(), params["blocks"], xs)
+        ref_block = transformer_block_fn(self.H, self.causal)
+        ys = sequential_reference(self._stage_fn(ref_block),
+                                  params["blocks"], xs)
         return self._head_loss(params, ys, labels)
 
     # -- training -------------------------------------------------------

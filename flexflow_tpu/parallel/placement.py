@@ -59,6 +59,7 @@ import heapq
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.ops.base import Op
+from flexflow_tpu.ops.base import point_slice as _point_slice
 
 
 @dataclasses.dataclass
@@ -99,26 +100,35 @@ def placement_slot(op: Op, num_devices: int):
     p = pc.num_parts
     if num_devices <= 1 or p > num_devices:
         return None
-    if op.placement_signature() is None or op.input_specs() is None:
+    if op.placement_signature() is None:
         return None
-    if op.init_state() and op.state_specs() is None:
-        return None  # stateful op without placed-state support
     if len(set(pc.devices)) != p or \
             any(d < 0 or d >= num_devices for d in pc.devices):
         return None  # duplicates / out-of-range ids: normalize + warn
+    if p == num_devices and pc.devices == tuple(range(num_devices)):
+        # canonical full-machine list: the normal (free) GSPMD path —
+        # never a placement group
+        return None
+    if op.input_specs() is None or \
+            (op.init_state() and op.state_specs() is None):
+        # block/stride execution impossible (no placed specs for this
+        # grid, or stateful without placed-state support) — but
+        # set-family point dispatch may still honor the list: an op
+        # overriding point_forward slices its own windows from the FULL
+        # replicated operands and needs neither (round 5, e.g. a
+        # stride-2 spatial conv on ANY duplicate-free device list)
+        return ("set", tuple(pc.devices)) if _set_eligible(op) else None
     if num_devices % p:
         # block/stride tilings need P | N; set-family per-device dispatch
         # does not (its flat mesh just leaves more devices on the zero
         # branch), so e.g. a (1,3) grid on (0,3,5) of 8 is still honored
         return ("set", tuple(pc.devices)) if _set_eligible(op) else None
     if p == num_devices:
-        # full-machine lists: canonical order is the normal (free) path;
-        # a single foreign permutation is absorbed by the machine-view
-        # rebuild (model._permuted_machine_view) before ops are built, so
-        # reaching here non-canonical means CONFLICTING permutations —
+        # non-canonical full-machine list (the canonical order returned
+        # above): a single foreign permutation is absorbed by the
+        # machine-view rebuild (model._permuted_machine_view) before ops
+        # are built, so reaching here means CONFLICTING permutations —
         # honor each via per-device dispatch (resharding at entry/exit)
-        if pc.devices == tuple(range(num_devices)):
-            return None
         return ("set", tuple(pc.devices)) if _set_eligible(op) else None
     # block/stride detection is order-insensitive: a strict-subset grid is
     # placement-symmetric (which grid point lands on which member device
@@ -138,11 +148,22 @@ def placement_slot(op: Op, num_devices: int):
 
 def _set_eligible(op: Op) -> bool:
     """Can ``op`` run under set-family per-device dispatch?  The runner
-    slices every operand per grid point and calls plain ``forward``, so
-    the op must be point-local: no collective prelude or grid-aware
-    sharded_forward for its grid (``placed_local``), no state, and every
-    spec entry a single axis name or None (the slicer's vocabulary)."""
-    if not op.placed_local() or op.init_state():
+    computes each grid point from the FULL (replicated) operands via
+    ``Op.point_forward``: the op must declare point capability
+    (``point_placeable`` — by default the point-local bar; spatial
+    conv/pool override it, their halos being static slices of the full
+    input), and its OUTPUT specs must be single-axis entries dividing
+    evenly (the assembler's vocabulary).  STATEFUL members (round 5)
+    need placed-state specs AND a point_forward override that computes
+    from the full input (BatchNorm: global statistics, zero
+    collectives).  Ops on the default ``point_forward`` additionally
+    need sliceable input and param specs (the default slices by spec;
+    overriders slice their own windows)."""
+    if not op.point_placeable():
+        return False
+    if op.init_state() and (
+            op.state_specs() is None
+            or type(op).point_forward is Op.point_forward):
         return False
     sizes = dict(zip(op.AXIS_NAMES, op.pc.dims))
 
@@ -166,9 +187,6 @@ def _set_eligible(op: Op) -> bool:
     if outs is None or not all(
             ok(s, t.shape) for s, t in zip(outs, op.all_outputs())):
         return False
-    if not all(ok(s, t.shape)
-               for s, t in zip(op.input_specs(), op.inputs)):
-        return False
     params = op.param_specs()
     if params:
         import jax
@@ -176,6 +194,11 @@ def _set_eligible(op: Op) -> bool:
         shapes = jax.eval_shape(lambda: op.init_params(
             jax.random.PRNGKey(0)))
         if not all(ok(params[k], shapes[k].shape) for k in params):
+            return False  # param point-slicing is shared by both paths
+    if type(op).point_forward is Op.point_forward:
+        if op.input_specs() is None or not all(
+                ok(s, t.shape)
+                for s, t in zip(op.input_specs(), op.inputs)):
             return False
     return True
 
@@ -554,40 +577,46 @@ def run_group(machine, group: PlacementGroup,
               params_by_member: List[Dict],
               inputs_by_member: List[List], train: bool,
               states_by_member: Optional[List[Dict]] = None,
-              prestacked: Optional[List[bool]] = None):
+              prestacked: Optional[List[bool]] = None,
+              state_prestacked: Optional[List[bool]] = None):
     """Execute a placement group jointly.  Returns
     ``(outs_by_member, new_states_by_member)``: per member, the tuple of
     its output arrays (each sliced from the group-stacked result, so it
     physically lives on that member's device block) and its new state
-    dict ({} for stateless members)."""
+    dict ({} for stateless members).  ``state_prestacked`` members'
+    state arrives AND returns in the stacked (G, ...) block-resident
+    layout (round 5 — no state byte crosses the group axis)."""
     if states_by_member is None:
         states_by_member = [{} for _ in group.members]
     hetero = len({_signature(op) for op in group.members}) > 1
-    if prestacked and any(prestacked) and group.device_rows is not None:
-        # the set-family path consumes raw member trees — slice
-        # block-resident leaves back to the member's row (a rare
-        # fallback: the registry excludes set groups, but schedule
-        # variants under other fusion exclusions can reshuffle members)
-        import jax
-
-        params_by_member = [
-            jax.tree.map(lambda l: l[g], p) if pre else p
-            for p, g, pre in zip(params_by_member, group.slots, prestacked)]
-        prestacked = None
     if group.device_rows is not None:
-        assert all(not s for s in states_by_member), \
-            "set-family groups are stateless (placement_slot gates this)"
         return _run_group_set(machine, group, params_by_member,
-                              inputs_by_member, train)
+                              inputs_by_member, train,
+                              prestacked or [False] * len(group.members),
+                              states_by_member,
+                              state_prestacked
+                              or [False] * len(group.members))
     if hetero:
-        return _run_group_hetero(machine, group, params_by_member,
-                                 inputs_by_member, train,
-                                 states_by_member,
-                                 prestacked or [False] * len(group.members))
-    return _run_group_homogeneous(machine, group, params_by_member,
-                                  inputs_by_member, train,
-                                  states_by_member,
-                                  prestacked or [False] * len(group.members))
+        return _run_group_hetero(
+            machine, group, params_by_member, inputs_by_member, train,
+            states_by_member,
+            prestacked or [False] * len(group.members),
+            state_prestacked or [False] * len(group.members))
+    return _run_group_homogeneous(
+        machine, group, params_by_member, inputs_by_member, train,
+        states_by_member,
+        prestacked or [False] * len(group.members),
+        state_prestacked or [False] * len(group.members))
+
+
+def grid_index(j: int, dims, axes) -> Dict[str, int]:
+    """Grid-linear ``j`` (dim 0 fastest — the Rect order) -> per-axis
+    index dict."""
+    idx = {}
+    for a, d in zip(axes, dims):
+        idx[a] = j % d
+        j //= d
+    return idx
 
 
 def set_group_assignment(group: PlacementGroup,
@@ -601,27 +630,10 @@ def set_group_assignment(group: PlacementGroup,
     dims = group.members[0].pc.dims
     for m, row in enumerate(group.device_rows):
         for j, dev in enumerate(row):
-            rem, idx = j, {}
-            for a, d in zip(axis_names, dims):
-                idx[a] = rem % d
-                rem //= d
-            out[dev] = (m, j, idx)
+            out[dev] = (m, j, grid_index(j, dims, axis_names))
     return out
 
 
-def _point_slice(arr, spec, sizes, idx):
-    """Static slice of one grid point's block of ``arr`` per its
-    PartitionSpec (single-axis-or-None entries — _set_eligible's bar)."""
-    entries = tuple(spec) + (None,) * (arr.ndim - len(tuple(spec)))
-    sl = []
-    for d, e in enumerate(entries):
-        parts = sizes.get(e, 1) if e is not None else 1
-        if parts == 1:
-            sl.append(slice(None))
-        else:
-            n = arr.shape[d] // parts
-            sl.append(slice(idx[e] * n, (idx[e] + 1) * n))
-    return arr[tuple(sl)]
 
 
 def _assemble(shards, spec, sizes, axis_names, dims):
@@ -650,7 +662,10 @@ def _assemble(shards, spec, sizes, axis_names, dims):
 
 def _run_group_set(machine, group: PlacementGroup,
                    params_by_member: List[Dict],
-                   inputs_by_member: List[List], train: bool):
+                   inputs_by_member: List[List], train: bool,
+                   prestacked: Optional[List[bool]] = None,
+                   states_by_member: Optional[List[Dict]] = None,
+                   state_prestacked: Optional[List[bool]] = None):
     """Arbitrary-device-list members (round 4, closing SURVEY §2.4): an
     irregular list like ``(0,3,5,6)`` cannot be a mesh reordering (XLA
     admits ONE device assignment per computation; block/stride placement
@@ -660,10 +675,19 @@ def _run_group_set(machine, group: PlacementGroup,
     assigned it — the reference's tag-based per-task pinning
     (nmt/rnn_mapper.cc:28-41) compiled into one SPMD computation.
 
-    The price, paid at group entry/exit rather than silently dropping the
-    placement (the pre-round-4 normalization): operands are replicated to
-    all devices (each branch statically slices its point's block), and
-    outputs return through a per-device stacked array."""
+    The price, paid at group entry/exit rather than silently dropping
+    the placement (the pre-round-4 normalization): operands are
+    replicated to all devices (each branch computes its point via
+    ``Op.point_forward`` from the full inputs — which is also what
+    admits spatial/halo and irregular-window members, round 5), and
+    outputs return through a per-device stacked array.  PARAMS no
+    longer pay that price: block-resident members
+    (model._derive_block_params, set family) arrive as per-device point
+    rows ``(N, *point_shape)`` sharded over ``_dev`` — each device
+    reads row [0] of its local block, so no parameter byte crosses the
+    tier at entry, and gradients/optimizer state stay resident the same
+    way (the reference keeps weights on their op's GPUs,
+    nmt/rnn.cu:159-296)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -679,30 +703,51 @@ def _run_group_set(machine, group: PlacementGroup,
     mesh = machine.flat_mesh()
     N = machine.num_devices
     assign = set_group_assignment(group, axes)
-    in_specs_per_op = op0.input_specs()
     out_specs_per_op = op0.output_specs()
     pspecs = op0.param_specs()
-    k_in = len(in_specs_per_op)
+    sspecs = op0.state_specs() or {}
+    k_in = len(op0.inputs)
+    prestacked = prestacked or [False] * len(ops)
+    states_by_member = states_by_member or [{} for _ in ops]
+    state_prestacked = state_prestacked or [False] * len(ops)
+    have_state = bool(states_by_member and states_by_member[0])
+    state_keys = sorted(states_by_member[0]) if have_state else []
 
-    have_params = bool(params_by_member and params_by_member[0])
-    stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *params_by_member) \
-        if have_params else {}
     flat_inputs = [x for xs in inputs_by_member for x in xs]
+    param_in_specs = tuple(
+        jax.tree.map(lambda _, pre=pre: P("_dev") if pre else P(), p)
+        for p, pre in zip(params_by_member, prestacked))
+    state_in_specs = tuple(
+        jax.tree.map(lambda _, pre=pre: P("_dev") if pre else P(), st)
+        for st, pre in zip(states_by_member, state_prestacked))
 
-    def body(sp, *flat):
+    def body(*args):
+        sp_by_member = args[:len(ops)]
+        st_by_member = args[len(ops):2 * len(ops)]
+        flat = args[2 * len(ops):]
         dev = lax.axis_index("_dev")
         xs_by_member = [list(flat[m * k_in:(m + 1) * k_in])
                         for m in range(len(ops))]
 
         def branch_for(m, idx):
             def br(_):
-                # params: member m's leaves, each sliced to the point
-                lp = {k: _point_slice(v[m], pspecs[k], sizes, idx)
-                      for k, v in sp.items()} if have_params else {}
-                xs = [_point_slice(x, s, sizes, idx)
-                      for x, s in zip(xs_by_member[m], in_specs_per_op)]
-                res, _ = ops[m].forward(lp, {}, xs, train)
-                outs = res if isinstance(res, tuple) else (res,)
+                sp = sp_by_member[m]
+                if prestacked[m]:
+                    # per-device point row: [0] of the local (1, ...)
+                    # block — already this point's slice, zero traffic
+                    lp = jax.tree.map(lambda l: l[0], sp)
+                else:
+                    lp = {k: _point_slice(v, pspecs[k], sizes, idx)
+                          for k, v in sp.items()}
+                st = st_by_member[m]
+                if state_prestacked[m]:
+                    ls = jax.tree.map(lambda l: l[0], st)
+                else:
+                    ls = {k: _point_slice(v, sspecs[k], sizes, idx)
+                          for k, v in st.items()}
+                outs, new_st = ops[m].point_forward(
+                    lp, ls, xs_by_member[m], idx, sizes, train)
+                outs = outs + tuple(new_st[k] for k in state_keys)
                 return tuple(jnp.expand_dims(o, 0) for o in outs)
             return br
 
@@ -718,27 +763,62 @@ def _run_group_set(machine, group: PlacementGroup,
     n_out = len(out_specs_per_op)
     res = unchecked_shard_map(
         body, mesh,
-        (jax.tree.map(lambda _: P(), stacked),) + (P(),) * len(flat_inputs),
-        tuple(P("_dev") for _ in range(n_out)))(stacked, *flat_inputs)
+        param_in_specs + state_in_specs + (P(),) * len(flat_inputs),
+        tuple(P("_dev") for _ in range(n_out + len(state_keys))))(
+            *params_by_member, *states_by_member, *flat_inputs)
+    new_states = []
+    if state_keys:
+        import numpy as _np
+
+        for m, (row, spre) in enumerate(zip(group.device_rows,
+                                            state_prestacked)):
+            st = {}
+            for i, k in enumerate(state_keys):
+                r = res[n_out + i]
+                if spre:
+                    # keep the (N, ...) per-device storage with only
+                    # this member's rows live — a static boolean mask,
+                    # row-local (slicing would gather across devices)
+                    mask = _np.zeros((N,) + (1,) * (r.ndim - 1), bool)
+                    mask[list(row)] = True
+                    st[k] = jnp.where(jnp.asarray(mask), r,
+                                      jnp.zeros_like(r))
+                else:
+                    st[k] = _assemble([r[d] for d in row], sspecs[k],
+                                      sizes, axes, dims)
+            new_states.append(st)
+    else:
+        new_states = [{} for _ in ops]
+    res = res[:n_out]
 
     out = []
+    repl = machine.replicated()
     for m, row in enumerate(group.device_rows):
         vals = []
         for r, spec in zip(res, out_specs_per_op):
             shards = [r[d] for d in row]  # grid-linear order by contract
             v = _assemble(shards, spec, sizes, axes, dims)
+            # explicit replicated waypoint: the row-gather out of the
+            # per-device stacked layout has no efficient GSPMD lowering
+            # to an arbitrary grid sharding — without the waypoint the
+            # partitioner takes the same replicate-then-slice path
+            # anyway, but as an "involuntary full rematerialization"
+            # (warned); stating it keeps the program identical and the
+            # compile log clean
+            v = lax.with_sharding_constraint(v, repl)
             v = lax.with_sharding_constraint(
                 v, machine.sharding(ops[m].pc, axes, spec))
             vals.append(v)
         out.append(tuple(vals))
-    return out, [{} for _ in ops]
+    return out, new_states
 
 
 def _run_group_homogeneous(machine, group: PlacementGroup,
                            params_by_member: List[Dict],
                            inputs_by_member: List[List], train: bool,
                            states_by_member: List[Dict],
-                           prestacked: Optional[List[bool]] = None):
+                           prestacked: Optional[List[bool]] = None,
+                           state_prestacked: Optional[List[bool]] = None):
     """Same-signature members: params (and state, round 3 — lifting the
     BatchNorm exclusion) stacked leaf-wise over the group axis with their
     inner sharding preserved; every branch shares one output aval.
@@ -761,43 +841,42 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
     k_in = len(op0.input_specs())
 
     prestacked = prestacked or [False] * len(ops)
+    state_prestacked = state_prestacked or [False] * len(ops)
 
-    def stack_leaf(*member_leaves):
-        by = dict(zip(slots, member_leaves))
-        z = jnp.zeros_like(member_leaves[0])
-        return jnp.stack([by.get(g, z) for g in range(G)])
-
-    def stack_param_leaf(*member_leaves):
-        """(G, ...) group-stacked PARAM leaf.  BLOCK-RESIDENT members
-        arrive already stacked and _pg-sharded
-        (model._derive_block_params) — their rows merge by a one-hot
-        mask-sum, all block-local, so no parameter byte crosses the
-        group axis (on a two-tier machine, DCN); legacy unstacked
-        members go through jnp.stack as before (GSPMD reshards them to
-        the group layout).  State always takes the plain stack_leaf
-        path — the prestacked flags describe params only."""
-        by = {}
-        pre = []
-        for leaf, g, p in zip(member_leaves, slots, prestacked):
-            if p:
-                io = jax.lax.broadcasted_iota(
-                    jnp.int32, (G,) + (1,) * (leaf.ndim - 1), 0)
-                pre.append(jnp.where(io == g, leaf,
-                                     jnp.zeros_like(leaf)))
-            else:
-                by[g] = leaf
-        out = None
-        if by:
-            z = jnp.zeros_like(next(iter(by.values())))
-            out = jnp.stack([by.get(g, z) for g in range(G)])
-        for v in pre:
-            out = v if out is None else out + v
-        return out
+    def make_stacker(flags):
+        """(G, ...) group-stacked leaf merger.  BLOCK-RESIDENT members
+        (model._derive_block_params) arrive already stacked and
+        _pg-sharded — their rows merge by a one-hot mask-sum, all
+        block-local, so no byte crosses the group axis (on a two-tier
+        machine, DCN); legacy unstacked members go through jnp.stack as
+        before (GSPMD reshards them to the group layout).  Shared by
+        params (``prestacked`` flags) and, round 5, state
+        (``state_prestacked``)."""
+        def stack(*member_leaves):
+            by = {}
+            pre = []
+            for leaf, g, p in zip(member_leaves, slots, flags):
+                if p:
+                    io = jax.lax.broadcasted_iota(
+                        jnp.int32, (G,) + (1,) * (leaf.ndim - 1), 0)
+                    pre.append(jnp.where(io == g, leaf,
+                                         jnp.zeros_like(leaf)))
+                else:
+                    by[g] = leaf
+            out = None
+            if by:
+                z = jnp.zeros_like(next(iter(by.values())))
+                out = jnp.stack([by.get(g, z) for g in range(G)])
+            for v in pre:
+                out = v if out is None else out + v
+            return out
+        return stack
 
     # ---- stack params along the group axis (zeros in unowned blocks) ----
     have_params = bool(params_by_member and params_by_member[0])
     if have_params:
-        stacked = jax.tree.map(stack_param_leaf, *params_by_member)
+        stacked = jax.tree.map(make_stacker(prestacked),
+                               *params_by_member)
         pspecs = {k: P("_pg", *spec)
                   for k, spec in op0.param_specs().items()}
     else:
@@ -806,7 +885,8 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
     # ---- state threaded the same way (state_specs gates placement) ----
     have_state = bool(states_by_member and states_by_member[0])
     if have_state:
-        stacked_state = jax.tree.map(stack_leaf, *states_by_member)
+        stacked_state = jax.tree.map(make_stacker(state_prestacked),
+                                     *states_by_member)
         sspecs = {k: P("_pg", *spec)
                   for k, spec in op0.state_specs().items()}
         state_keys = sorted(states_by_member[0])
@@ -857,9 +937,23 @@ def _run_group_homogeneous(machine, group: PlacementGroup,
     res = unchecked_shard_map(body, mesh, in_specs, out_specs)(
         stacked, stacked_state, *flat_inputs)
     new_states = []
-    for g in slots:
-        new_states.append({k: res[n_out + i][g]
-                           for i, k in enumerate(state_keys)})
+    for j, g in enumerate(slots):
+        if state_prestacked[j]:
+            # block-resident member: return the FULL stacked (G, ...)
+            # array with only this member's row live — a one-hot mask is
+            # row-local, whereas slicing row g would gather across _pg
+            import jax as _jax
+
+            st = {}
+            for i, k in enumerate(state_keys):
+                r = res[n_out + i]
+                io = _jax.lax.broadcasted_iota(
+                    jnp.int32, (G,) + (1,) * (r.ndim - 1), 0)
+                st[k] = jnp.where(io == g, r, jnp.zeros_like(r))
+            new_states.append(st)
+        else:
+            new_states.append({k: res[n_out + i][g]
+                               for i, k in enumerate(state_keys)})
     res = res[:n_out]
     # Constrain each sliced member output to its pc's normalized sharding
     # (grid over the fast global axes, replicated over the rest).  This
@@ -884,7 +978,8 @@ def _run_group_hetero(machine, group: PlacementGroup,
                       params_by_member: List[Dict],
                       inputs_by_member: List[List], train: bool,
                       states_by_member: Optional[List[Dict]] = None,
-                      prestacked: Optional[List[bool]] = None):
+                      prestacked: Optional[List[bool]] = None,
+                      state_prestacked: Optional[List[bool]] = None):
     """Mixed-kind members (round 3; generalized round 4): each member is
     its own switch branch.
 
@@ -965,13 +1060,6 @@ def _run_group_hetero(machine, group: PlacementGroup,
             if leaves else jnp.zeros((0,), jnp.float32)
         return vec, (treedef, [(l.shape, str(l.dtype)) for l in leaves])
 
-    def stack_vecs(vecs):
-        lmax = max((v.shape[0] for v in vecs), default=0)
-        by_slot = {g: jnp.pad(v, (0, lmax - v.shape[0]))
-                   for g, v in zip(slots, vecs)}
-        zero = jnp.zeros((lmax,), jnp.float32)
-        return jnp.stack([by_slot.get(g, zero) for g in range(G)]), lmax
-
     # ---- params and state: flatten -> f32 ravel -> pad -> stack ----
     # BLOCK-RESIDENT members (model._derive_block_params) arrive as
     # stacked (G, ...) leaves.  Their group vector is built ROW-WISE —
@@ -1013,12 +1101,45 @@ def _run_group_hetero(machine, group: PlacementGroup,
         io = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
         stacked = stacked + jnp.where(io == g, padded,
                                       jnp.zeros_like(padded))
-    svecs, smetas = [], []
-    for m, st in zip(ops, states_by_member):
-        v, meta = ravel_tree(st, "state", m.name)
-        svecs.append(v)
-        smetas.append(meta)
-    stacked_state, smax = stack_vecs(svecs)
+    # state rides a second group-stacked f32 vector; round 5: BLOCK-
+    # RESIDENT state (stacked (G, ...) leaves) builds its rows the same
+    # row-wise way as params — reshape (G, -1), concat, one-hot mask —
+    # so no state byte crosses the group axis either
+    state_prestacked = state_prestacked or [False] * len(ops)
+    smetas = []
+    s_legacy = []      # (slot, 1-D vec)
+    s_pre_rows = []    # (slot, (G, L_m) row-local vectors)
+    for m, st, g, spre in zip(ops, states_by_member, slots,
+                              state_prestacked):
+        if spre:
+            leaves, treedef = jax.tree.flatten(st)
+            check_f32_family(leaves, "state", m.name)
+            for l in leaves:
+                assert l.shape[0] == G, (
+                    f"block-resident state leaf of {m.name!r} stacked "
+                    f"for {l.shape[0]} groups, mesh has {G}")
+            rowvec = jnp.concatenate(
+                [l.reshape(G, -1).astype(jnp.float32) for l in leaves],
+                axis=1) if leaves else jnp.zeros((G, 0), jnp.float32)
+            s_pre_rows.append((g, rowvec))
+            smetas.append((treedef,
+                           [(l.shape[1:], str(l.dtype)) for l in leaves]))
+        else:
+            v, meta = ravel_tree(st, "state", m.name)
+            s_legacy.append((g, v))
+            smetas.append(meta)
+    smax = max([r.shape[1] for _, r in s_pre_rows] +
+               [v.shape[0] for _, v in s_legacy] + [0])
+    s_by_slot = {g: jnp.pad(v, (0, smax - v.shape[0]))
+                 for g, v in s_legacy}
+    s_zero = jnp.zeros((smax,), jnp.float32)
+    stacked_state = jnp.stack([s_by_slot.get(g, s_zero)
+                               for g in range(G)])
+    for g, rowvec in s_pre_rows:
+        padded = jnp.pad(rowvec, ((0, 0), (0, smax - rowvec.shape[1])))
+        io = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+        stacked_state = stacked_state + jnp.where(
+            io == g, padded, jnp.zeros_like(padded))
 
     member_in_specs = [v[2] for v in views]
     in_specs = (P("_pg", None), P("_pg", None)) + tuple(
@@ -1031,8 +1152,9 @@ def _run_group_hetero(machine, group: PlacementGroup,
         def fwd(m=m):
             p = jax.tree.map(lambda l: l[slots[m]], params_by_member[m]) \
                 if prestacked[m] else params_by_member[m]
-            res, _ = ops[m].forward(p, states_by_member[m],
-                                    inputs_by_member[m], train)
+            s = jax.tree.map(lambda l: l[slots[m]], states_by_member[m]) \
+                if state_prestacked[m] else states_by_member[m]
+            res, _ = ops[m].forward(p, s, inputs_by_member[m], train)
             return res if isinstance(res, tuple) else (res,)
         real_avals.append(jax.eval_shape(fwd))
     offs = [0]
@@ -1174,6 +1296,24 @@ def _run_group_hetero(machine, group: PlacementGroup,
                     v, machine.sharding(m.pc, m.AXIS_NAMES, spec))
             vals.append(v)
         out.append(tuple(vals))
-        new_states.append(unravel(new_svecs[g], smetas[i])
-                          if states_by_member[i] else {})
+        if not states_by_member[i]:
+            new_states.append({})
+        elif state_prestacked[i]:
+            # rebuild the stacked (G, ...) storage row-locally: reshape
+            # the (G, smax) vector's columns, one-hot-mask the member's
+            # row (slicing row g would gather across _pg)
+            treedef, leaf_meta = smetas[i]
+            leaves, off = [], 0
+            for shape, dtype in leaf_meta:
+                size = int(_math.prod(shape))
+                seg = new_svecs[:, off:off + size] \
+                    .reshape((G,) + tuple(shape)).astype(dtype)
+                io = jax.lax.broadcasted_iota(
+                    jnp.int32, (G,) + (1,) * len(shape), 0)
+                leaves.append(jnp.where(io == g, seg,
+                                        jnp.zeros_like(seg)))
+                off += size
+            new_states.append(jax.tree.unflatten(treedef, leaves))
+        else:
+            new_states.append(unravel(new_svecs[g], smetas[i]))
     return out, new_states

@@ -676,7 +676,8 @@ class StrategySearch:
     def propose_pipeline(self, stage_options=None,
                          micro_options=(2, 4, 8), log=None,
                          reference_s=None, stage_divisor=None,
-                         batch=None):
+                         batch=None, tp_divisor=None,
+                         tp_options=(1, 2, 4)):
         """Cost GPipe (S stages x M microbatches) candidates against the
         plain (non-pipelined) DP execution and propose-or-reject a
         ``pipeline`` block for the strategy file (round 4, VERDICT r3
@@ -720,15 +721,28 @@ class StrategySearch:
                              and s <= len(layer_ops)
                              and (stage_divisor is None
                                   or stage_divisor % s == 0)]
+        # stage-internal TP (round 5, VERDICT r4 #5): each (S, tp)
+        # combination has its own dp width — TP's value in this space is
+        # admitting smaller microbatches (dp shrinks, so more M options
+        # pass the divisibility gate and the bubble shrinks) at the cost
+        # of per-microbatch Megatron all-reduces, priced below
+        # without a divisor the executor's divisibility (heads, d_ff)
+        # is unknown — propose only tp=1 rather than risk an artifact
+        # the consuming driver must reject
+        tp_opts = [1] if tp_divisor is None else \
+            [t for t in tp_options if tp_divisor % t == 0]
         # only microbatch counts the GPipe executor admits
         # (parallel/pipeline.py: batch % M == 0 and (batch//M) % dp == 0)
         feasible_micro = {}
         for S in stage_options:
-            dp_width = max(n // S, 1)
-            feasible_micro[S] = [
-                m for m in micro_options
-                if batch is None or (batch % m == 0
-                                     and (batch // m) % dp_width == 0)]
+            for t in tp_opts:
+                if (n // S) % t:
+                    continue
+                dp_width = max(n // (S * t), 1)
+                feasible_micro[(S, t)] = [
+                    m for m in micro_options
+                    if batch is None or (batch % m == 0
+                                         and (batch // m) % dp_width == 0)]
         candidates = []
         for S in stage_options:
             scale = float(S)
@@ -760,65 +774,103 @@ class StrategySearch:
             # under-priced pipelines on multi-tier topologies).  Bytes
             # follow the model's compute dtype, not hard-coded f32
             # (VERDICT r4 #5: the LM driver runs bf16 paths).
-            dp_width = max(n // S, 1)
+            stage_width = max(n // S, 1)   # devices per stage (= dp * tp)
             cdtype = getattr(getattr(self.model, "config", None),
                              "compute_dtype", "float32")
             dt_bytes = 2.0 if cdtype in ("bfloat16", "float16") else 4.0
-            cut_links = []  # (per-device bytes, bw, latency) per cut
-            for k, i in enumerate(cuts):
-                import math as _m
-
-                bytes_cut = dt_bytes * _m.prod(layer_ops[i].output.shape)
-                # the dp_width concurrent ppermutes complete at the
-                # slowest link (the _ring_step convention): DCN if any
-                # device's +dp peer lies in a different ICI group
-                crosses = any(
-                    d // topo.devices_per_ici_group
-                    != (d + dp_width) // topo.devices_per_ici_group
-                    for d in range(k * dp_width, (k + 1) * dp_width))
-                cut_links.append((
-                    bytes_cut / dp_width,
-                    topo.dcn_bandwidth if crosses else topo.ici_bandwidth,
-                    topo.dcn_latency if crosses else topo.ici_latency))
-            # stage-local gradient sync: hierarchical all-reduce over the
-            # stage's ACTUAL device block (two-tier aware via
-            # collectives._allreduce); stages sync concurrently, so the
-            # worst-placed stage prices the step
             from flexflow_tpu.sim.collectives import _allreduce
 
-            sync = max((_allreduce(
-                total_param_bytes / S,
-                tuple(range(s * dp_width, (s + 1) * dp_width)), topo)
-                for s in range(S)), default=0.0)
-            for M in feasible_micro[S]:
-                L = max(stage_sums) / M
-                # volume term is M-invariant (M microbatches together
-                # cross each cut once), but every microbatch pays the
-                # link latency: 2*M per cut (fwd + bwd)
-                comm = sum(2.0 * (per_dev / bw + M * lat)
-                           for per_dev, bw, lat in cut_links)
-                t = (M + S - 1) * L + comm + sync + self._opt_stream_s
-                candidates.append({
-                    "stages": S, "microbatches": M,
-                    "time_s": t, "stage_makespan_s": L,
-                    "bubble_factor": (M + S - 1) / M,
-                    "comm_s": comm, "param_sync_s": sync})
-                logger(
-                    "pipeline candidate S=%d M=%d: %.4fs (makespan "
-                    "%.4fs x %.2f bubble + %.4fs comm + %.4fs sync) "
-                    "vs %.4fs non-pipelined" % (S, M, t, L,
-                                           (M + S - 1) / M, comm, sync,
-                                           t_ref))
+            for tp in tp_opts:
+                if (S, tp) not in feasible_micro:
+                    continue
+                dp_width = max(stage_width // tp, 1)
+                # stage-local gradient sync: with tp>1 each device holds
+                # only 1/(S*tp) of the params and syncs over its dp
+                # peers (stride tp inside the stage block, PipelinedLM
+                # mesh (S, dp, tp)); hierarchical all-reduce prices the
+                # tier each peer hop crosses; stages sync concurrently,
+                # so the worst-placed stage prices the step
+                sync = max((_allreduce(
+                    total_param_bytes / (S * tp),
+                    tuple(s * stage_width + j * tp
+                          for j in range(dp_width)),
+                    topo) for s in range(S)), default=0.0)
+                cut_links = []  # (per-device bytes, bw, latency) per cut
+                for k, i in enumerate(cuts):
+                    import math as _m
+
+                    bytes_cut = dt_bytes * _m.prod(
+                        layer_ops[i].output.shape)
+                    # the concurrent boundary ppermutes complete at the
+                    # slowest link (the _ring_step convention): DCN if
+                    # any device's +stage_width peer lies in a different
+                    # ICI group
+                    crosses = any(
+                        d // topo.devices_per_ici_group
+                        != (d + stage_width) // topo.devices_per_ici_group
+                        for d in range(k * stage_width,
+                                       (k + 1) * stage_width))
+                    cut_links.append((
+                        bytes_cut / dp_width,
+                        topo.dcn_bandwidth if crosses
+                        else topo.ici_bandwidth,
+                        topo.dcn_latency if crosses
+                        else topo.ici_latency))
+                # stage-internal Megatron TP all-reduces: ~4 per
+                # parameterized layer per microbatch (2 fwd partial-sum
+                # merges + their transposes), of the layer's activation
+                # shard.  tp groups are ICI-contiguous innermost
+                # (PipelinedLM mesh (S, dp, tp)), so price over devices
+                # 0..tp-1.  Conservative: charged for every param-
+                # carrying layer — TP earns its keep via the smaller
+                # dp_width unlocking more microbatch options above.
+                tp_acts = []
+                if tp > 1:
+                    import math as _m
+
+                    tp_acts = [dt_bytes * _m.prod(op_l.output.shape)
+                               / dp_width
+                               for op_l in layer_ops
+                               if op_l.param_bytes() > 0]
+                tp_devs = tuple(range(tp))
+                for M in feasible_micro[(S, tp)]:
+                    L = max(stage_sums) / M
+                    # volume term is M-invariant (M microbatches together
+                    # cross each cut once), but every microbatch pays the
+                    # link latency: 2*M per cut (fwd + bwd)
+                    comm = sum(2.0 * (per_dev / bw + M * lat)
+                               for per_dev, bw, lat in cut_links)
+                    # M all-reduces of act/M each: bandwidth term is
+                    # M-invariant, latency scales with M
+                    tp_comm = sum(4.0 * M * _allreduce(a / M, tp_devs,
+                                                       topo)
+                                  for a in tp_acts)
+                    t = (M + S - 1) * L + comm + tp_comm + sync \
+                        + self._opt_stream_s
+                    candidates.append({
+                        "stages": S, "microbatches": M, "tp": tp,
+                        "time_s": t, "stage_makespan_s": L,
+                        "bubble_factor": (M + S - 1) / M,
+                        "comm_s": comm, "tp_comm_s": tp_comm,
+                        "param_sync_s": sync})
+                    logger(
+                        "pipeline candidate S=%d M=%d tp=%d: %.4fs "
+                        "(makespan %.4fs x %.2f bubble + %.4fs comm + "
+                        "%.4fs tp + %.4fs sync) vs %.4fs non-pipelined"
+                        % (S, M, tp, t, L, (M + S - 1) / M, comm,
+                           tp_comm, sync, t_ref))
         best = min(candidates, key=lambda c: c["time_s"], default=None)
         accepted = bool(best and best["time_s"] < t_ref)
         logger("pipeline decision: %s (best %s vs non-pipelined %.4fs)"
                % ("ACCEPT" if accepted else "REJECT",
                   f"S={best['stages']} M={best['microbatches']} "
-                  f"{best['time_s']:.4f}s" if best else "none", t_ref))
+                  f"tp={best['tp']} {best['time_s']:.4f}s"
+                  if best else "none", t_ref))
         return {"candidates": candidates, "reference_time_s": t_ref,
                 "accepted": accepted,
                 "best": ({"stages": best["stages"],
-                          "microbatches": best["microbatches"]}
+                          "microbatches": best["microbatches"],
+                          "tp": best["tp"]}
                          if accepted else None)}
 
     def search(self, iters: int = 250_000, beta: float = 5e3,

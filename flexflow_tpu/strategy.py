@@ -123,7 +123,8 @@ class Strategy(dict):
         if self.pipeline:
             obj["__pipeline__"] = {
                 "stages": int(self.pipeline["stages"]),
-                "microbatches": int(self.pipeline["microbatches"])}
+                "microbatches": int(self.pipeline["microbatches"]),
+                "tp": int(self.pipeline.get("tp", 1))}
         return json.dumps(obj, indent=2, sort_keys=True)
 
     @classmethod
@@ -133,7 +134,8 @@ class Strategy(dict):
         pp = obj.pop("__pipeline__", None)
         if pp:
             s.pipeline = {"stages": int(pp["stages"]),
-                          "microbatches": int(pp["microbatches"])}
+                          "microbatches": int(pp["microbatches"]),
+                          "tp": int(pp.get("tp", 1))}
         for name, d in obj.items():
             s[name] = ParallelConfig(tuple(d["dims"]), tuple(d["devices"]))
         return s

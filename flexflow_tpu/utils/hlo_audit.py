@@ -108,7 +108,7 @@ def collective_bytes(hlo: str, group_size: int) -> Tuple[float, float]:
 
 def _build_model(model_name: str, machine, batch_size: Optional[int],
                  strategy_path: str, seed: int = 3,
-                 dtype: str = "float32"):
+                 dtype: str = "float32", experts: int = 0):
     """(model, example_batch) for ``model_name`` with ``strategy_path``
     applied (empty = pure DP) — the same builders the training drivers
     use, so the audited program IS the program a user would run."""
@@ -131,7 +131,8 @@ def _build_model(model_name: str, machine, batch_size: Optional[int],
         from flexflow_tpu.models.transformer import (TransformerConfig,
                                                      TransformerLM)
 
-        tc = TransformerConfig(seed=seed, compute_dtype=dtype)
+        tc = TransformerConfig(seed=seed, compute_dtype=dtype,
+                               num_experts=experts)
         if batch_size:
             tc.batch_size = batch_size
         if model_name == "gpt":
@@ -169,7 +170,8 @@ def audit_in_process(model_name: str, devices: int, ici_group: int,
                      strategy_path: str,
                      batch_size: Optional[int] = None,
                      seed: int = 3, dtype: str = "float32",
-                     dp_known: Optional[Tuple[float, float]] = None) -> dict:
+                     dp_known: Optional[Tuple[float, float]] = None,
+                     experts: int = 0) -> dict:
     """Lower ``strategy_path`` AND pure DP on a ``devices``-device machine
     view with ``ici_group``-sized ICI groups; count cross-/intra-tier
     collective bytes of both compiled programs.  Requires that many live
@@ -194,7 +196,7 @@ def audit_in_process(model_name: str, devices: int, ici_group: int,
             cross, intra = dp_known
         else:
             model, batch = _build_model(model_name, machine, batch_size,
-                                        path, seed, dtype)
+                                        path, seed, dtype, experts)
             cross, intra = collective_bytes(_lowered_text(model, batch),
                                             ici_group)
         out[f"{key}_cross_bytes"] = cross
@@ -228,7 +230,8 @@ def audit_subprocess(model_name: str, devices: int, ici_group: int,
                      batch_size: Optional[int] = None, seed: int = 3,
                      timeout: float = 900.0,
                      dtype: str = "float32",
-                     dp_known: Optional[Tuple[float, float]] = None) -> dict:
+                     dp_known: Optional[Tuple[float, float]] = None,
+                     experts: int = 0) -> dict:
     """Run :func:`audit_in_process` in a fresh CPU process with
     ``devices`` virtual host devices — callable from any parent (the
     offline search may be running against one real TPU chip, where an
@@ -250,6 +253,8 @@ def audit_subprocess(model_name: str, devices: int, ici_group: int,
         cmd += ["--dtype", dtype]
     if dp_known is not None:
         cmd += ["--dp-known", f"{dp_known[0]},{dp_known[1]}"]
+    if experts:
+        cmd += ["--experts", str(experts)]
     proc = subprocess.run(cmd, capture_output=True, text=True,
                           timeout=timeout, env=env, cwd=repo)
     if proc.returncode != 0:
@@ -270,7 +275,7 @@ def main(argv=None):
     args = list(sys.argv[1:] if argv is None else argv)
     opts = {"model": "alexnet", "devices": 8, "ici_group": 4,
             "strategy": "", "batch_size": None, "seed": 3,
-            "dtype": "float32", "dp_known": None}
+            "dtype": "float32", "dp_known": None, "experts": 0}
     if args and not args[0].startswith("-"):
         opts["model"] = args.pop(0)
     for a, val in flag_stream(args):
@@ -289,6 +294,8 @@ def main(argv=None):
         elif a == "--dp-known":
             c, i = val().split(",")
             opts["dp_known"] = (float(c), float(i))
+        elif a == "--experts":
+            opts["experts"] = int(val())
     # force the virtual CPU mesh BEFORE any backend init: env vars alone
     # do not suffice under the TPU tunnel (its sitecustomize pre-imports
     # jax, same reason tests/conftest.py uses jax.config)
@@ -303,7 +310,8 @@ def main(argv=None):
     out = audit_in_process(opts["model"], opts["devices"],
                            opts["ici_group"], opts["strategy"],
                            opts["batch_size"], opts["seed"],
-                           opts["dtype"], opts["dp_known"])
+                           opts["dtype"], opts["dp_known"],
+                           opts["experts"])
     print(json.dumps(out))
 
 

@@ -201,7 +201,7 @@ def test_hetero_overlap_structure(monkeypatch):
 
     machine = MachineModel()
     monkeypatch.setattr(FFModel, "_derive_block_params",
-                        lambda self, sched: {})
+                        lambda self, sched: ({}, {}))
 
     def build_and_compile():
         ff = _two_conv_model(machine, True)
@@ -422,9 +422,14 @@ def test_batchnorm_joins_mixed_group_with_state():
     np.testing.assert_allclose(got_l, want_l, rtol=2e-4)
     import jax
 
+    # round 5: placed-member state is stored block-resident (stacked
+    # (G, ...)); compare the member's view of it
+    # (tests/test_state_residency.py pins the layout itself)
+    bn_op = [o for o in ff.layers if o.name == "bnA"][0]
+    got_member = ff._member_state({"bnA": got_s["bnA"]}, bn_op)
     for k in want_s.get("bnA", {}):
         np.testing.assert_allclose(
-            np.asarray(jax.device_get(got_s["bnA"][k])),
+            np.asarray(jax.device_get(got_member[k])),
             np.asarray(jax.device_get(want_s["bnA"][k])), rtol=1e-4)
 
 

@@ -198,7 +198,8 @@ def test_propose_pipeline_costs_and_decides(machine8):
     assert pp["accepted"] == (best["time_s"] < pp["reference_time_s"])
     if pp["accepted"]:
         assert pp["best"] == {"stages": best["stages"],
-                              "microbatches": best["microbatches"]}
+                              "microbatches": best["microbatches"],
+                              "tp": best["tp"]}
 
 
 def test_pipeline_block_file_matches_flags(machine8, tmp_path):
@@ -223,3 +224,101 @@ def test_pipeline_block_file_matches_flags(machine8, tmp_path):
 
     np.testing.assert_allclose(via_file["loss"], via_flags["loss"],
                                rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round 5 (VERDICT r4 #5): per-op strategies inside GPipe stages —
+# stage-internal Megatron TP on a ("stage", "n", "tp") mesh, driven by the
+# strategy file (explicit "tp" in the pipeline block, or derived from the
+# file's per-op attention entries).
+
+
+def test_pipelined_lm_tp_matches_sequential(machine8):
+    """PipelinedLM with tp=2 (PP x DP x TP) == the sequential full-math
+    reference: the Megatron psums reconstruct the exact block output."""
+    import jax.numpy as jnp
+
+    from flexflow_tpu.parallel.pipeline import PipelinedLM
+
+    model = PipelinedLM(machine8, num_stages=2, num_microbatches=2,
+                        num_layers=4, d_model=16, num_heads=4, d_ff=32,
+                        vocab_size=64, seq_length=16, batch_size=8, tp=2)
+    params = model.init(0)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 16)),
+                       "int32")
+    a = float(model.loss_fn(params, toks, toks))
+    b = float(model.loss_reference(params, toks, toks))
+    assert abs(a - b) < 1e-4, (a, b)
+    # TP weights are physically sharded: a tp-split leaf has per-device
+    # shards smaller than the leaf
+    w1 = params["blocks"]["w1"]
+    assert len({sh.device for sh in w1.addressable_shards}) == 8
+    shard_elems = max(np.prod(sh.data.shape)
+                      for sh in w1.addressable_shards)
+    assert shard_elems <= w1.size // 4  # S=2 stages x tp=2
+    # and it trains
+    step = model.make_train_step()
+    params, l0 = step(params, toks, toks)
+    for _ in range(4):
+        params, l1 = step(params, toks, toks)
+    assert float(l1) < float(l0)
+
+
+def test_pipeline_block_tp_from_file(machine8, tmp_path):
+    """A strategy file whose __pipeline__ block carries tp=2 drives the
+    PP x DP x TP run; per-op TP entries in the same file (head-axis
+    splits) imply the same tp when the block has none — both execute,
+    closing the 'per-op entries are advisory' gap."""
+    from flexflow_tpu.apps import lm
+    from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+    common = ["-b", "16", "-s", "16", "-l", "4", "--d-model", "64",
+              "--heads", "4", "--d-ff", "128", "--vocab", "256",
+              "--iters", "2", "--seed", "5"]
+
+    s = Strategy()
+    s.pipeline = {"stages": 2, "microbatches": 2, "tp": 2}
+    p1 = tmp_path / "pp_tp.json"
+    p1.write_text(s.to_json())
+    via_block = lm.main(common + ["--strategy", str(p1)],
+                        log=lambda *a: None)
+
+    s2 = Strategy()
+    s2.pipeline = {"stages": 2, "microbatches": 2}
+    # per-op attention entries with a 2-way head split: rank-3 grids
+    # ("s", "h", "n") — the pipeline path derives tp=2 from them
+    s2["attn0"] = ParallelConfig((1, 2, 4), tuple(range(8)))
+    s2["attn1"] = ParallelConfig((1, 2, 4), tuple(range(8)))
+    p2 = tmp_path / "pp_perop.json"
+    p2.write_text(s2.to_json())
+    logs = []
+    via_perop = lm.main(common + ["--strategy", str(p2)],
+                        log=lambda m: logs.append(str(m)))
+    assert any("tp=2" in l for l in logs), logs
+
+    via_flags = lm.main(common + ["--pipeline-stages", "2",
+                                  "--microbatches", "2",
+                                  "--pipeline-tp", "2"],
+                        log=lambda *a: None)
+    np.testing.assert_allclose(via_block["loss"], via_flags["loss"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(via_perop["loss"], via_flags["loss"],
+                               rtol=1e-6)
+
+
+def test_propose_pipeline_tp_candidates(machine8):
+    """With a tp_divisor the candidate space includes tp>1 entries, each
+    carrying its tp comm cost; tp respects the divisor."""
+    from flexflow_tpu.apps.search import build_model
+    from flexflow_tpu.sim.search import StrategySearch
+
+    model = build_model("transformer", machine8, 32)
+    search = StrategySearch(model, machine8)
+    pp = search.propose_pipeline(log=lambda *a: None, tp_divisor=4,
+                                 batch=32, stage_divisor=model.t.num_layers)
+    tps = {c["tp"] for c in pp["candidates"]}
+    assert 1 in tps and (2 in tps or 4 in tps)
+    assert all(c["tp"] in (1, 2, 4) for c in pp["candidates"])
+    for c in pp["candidates"]:
+        if c["tp"] > 1:
+            assert c["tp_comm_s"] > 0

@@ -411,6 +411,10 @@ def test_placed_batchnorm_state_and_parity():
     bn = [o for o in ff.layers if o.name == "bn1"][0]
     assert placement_slot(bn, 8) == ("block", 1)
     losses_p, st_p = run(ff)
+    # round 5: placed-member state is stored BLOCK-RESIDENT — stacked
+    # (G, ...) with the member's row live (tests/test_state_residency.py
+    # pins the layout); compare the member's view of it
+    st_p = ff._member_state({"bn1": st_p}, bn)
     losses_c, st_c = run(build(Strategy()))
     np.testing.assert_allclose(losses_p, losses_c, rtol=2e-4)
     np.testing.assert_allclose(st_p["mean"], st_c["mean"], rtol=1e-3,
