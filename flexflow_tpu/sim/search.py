@@ -19,7 +19,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.ops.base import Op
-from flexflow_tpu.sim.collectives import collective_cost
+from flexflow_tpu.sim.collectives import (collective_cost,
+                                          dispatch_overhead_cost)
 from flexflow_tpu.sim.cost_model import AnalyticCostModel
 from flexflow_tpu.sim.native import NativeSimulator
 from flexflow_tpu.strategy import ParallelConfig, Strategy
@@ -553,7 +554,14 @@ class StrategySearch:
                 cost_pairs.append((len(costs), op, pc))
                 costs.append(0.0)  # resolved in the two-pass loop below
                 replicas.append(self._param_replicas(op, pc))
-                colls.append(collective_cost(op, pc, topo))
+                # in-op collectives + the placed-execution entry/exit
+                # resharding (round 5 — the executor replicates operands
+                # and stacks outputs for subset placements; pricing it
+                # keeps the search honest about what GSPMD lowers, the
+                # gap the NMT volume audit exposed)
+                colls.append(collective_cost(op, pc, topo)
+                             + dispatch_overhead_cost(op, pc, topo,
+                                                      n_dev))
             # shared weights (param_key) are synced once per step, not once
             # per chunk op — charge the first op carrying the key
             if op.param_key in seen_param_keys:

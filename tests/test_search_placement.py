@@ -107,8 +107,12 @@ def test_searched_placement_strategy_executes(machine8):
                                             synthetic_token_batches)
 
     machine = _two_tier_machine()
+    # hidden 256: big enough that placement survives the round-5
+    # dispatch-overhead pricing (entry/exit resharding of placed groups
+    # is now charged, so a TOY op's placement honestly loses — at this
+    # width the wavefront win still dominates, 6 sub-machine entries)
     cfg = RnnConfig(batch_size=8, num_layers=1, seq_length=8,
-                    hidden_size=16, embed_size=16, vocab_size=64,
+                    hidden_size=256, embed_size=256, vocab_size=64,
                     lstm_per_node_length=4, num_iterations=1)
     model = RnnModel(cfg, machine)
     search = StrategySearch(model, machine)
