@@ -1,5 +1,14 @@
-"""Benchmark entry point — prints ONE JSON line:
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""Benchmark entry point — prints EXACTLY ONE JSON line on stdout:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+ "run_id": ..., "obs_path": ...}
+
+Stdout hygiene: everything else (logging, JAX/absl warnings, any library
+print) is routed to stderr, so the consuming harness parses stdout
+directly instead of grepping the metric out of mixed tail text.  The full
+bench record is also appended to the obs event stream (run-telemetry
+JSONL; dir from $BENCH_OBS_DIR, default ``.obs/`` next to this file) and
+its run-id + path ride in the metric line; render with
+``python -m flexflow_tpu.apps.report``.
 
 Flagship benchmark: Inception-v3 (the BASELINE.json north-star model;
 reference topology inception.h / cnn.cc:191-214) training throughput per
@@ -108,6 +117,19 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
 
 
 def main():
+    import contextlib
+    import logging
+
+    # stdout hygiene: the metric line is the ONLY stdout byte this
+    # process emits — logging and any library print go to stderr
+    logging.basicConfig(stream=sys.stderr)
+    real_stdout = sys.stdout
+    with contextlib.redirect_stdout(sys.stderr):
+        out = _bench_record()
+    print(json.dumps(out), file=real_stdout)
+
+
+def _bench_record():
     model = os.environ.get("BENCH_MODEL", "inception")
     strategy_file = sys.argv[1] if len(sys.argv) > 1 else None
     per_chip, tput, elapsed, mfu, spread = run(model=model,
@@ -143,7 +165,26 @@ def main():
             out["simulated_speedup_vs_dp"] = summary[key]["speedup_vs_dp"]
     except Exception:
         pass
-    print(json.dumps(out))
+    # bench surface of the obs subsystem: the full record also lands in
+    # the run-telemetry JSONL, and its identity rides in the metric line
+    try:
+        from flexflow_tpu import obs as _obs
+
+        obs_dir = os.environ.get(
+            "BENCH_OBS_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".obs"))
+        run_id = _obs.new_run_id()
+        with _obs.RunLog(os.path.join(obs_dir, f"{run_id}.jsonl"),
+                         run_id=run_id, surface="bench",
+                         meta={"app": "bench", "model": model,
+                               "strategy_file": strategy_file or ""}) as ol:
+            ol.event("bench", **out)
+            out["run_id"] = run_id
+            out["obs_path"] = ol.path
+    except Exception as e:
+        print(f"obs record unavailable: {e}", file=sys.stderr)
+    return out
 
 
 if __name__ == "__main__":

@@ -73,6 +73,10 @@ def parse_args(argv) -> TransformerConfig:
             cfg._microbatches = int(val())
         elif a == "--pipeline-tp":
             cfg._pipeline_tp = int(val())
+        elif a in ("-obs-dir", "--obs-dir"):
+            cfg.obs_dir = val()
+        elif a in ("-run-id", "--run-id"):
+            cfg.run_id = val()
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg

@@ -8,8 +8,12 @@ Extras beyond the reference: --vocab, --iters, --chunk (LSTM steps per
 chunk op), --strategy <file>, --pipeline-stages S (generate the stage
 strategy: LSTM layer l on device block l%S — the reference's per-op
 placement pipelining, nmt/nmt.cc:269-308 — and wavefront-execute it),
---dtype, --seed.  Data is synthetic random token pairs (the reference
-initializes its word tensors with constants, nmt/rnn.cu:89-126).
+--dtype, --seed, and -obs-dir DIR / -run-id ID (run telemetry: append
+the structured training event stream — compile, per-step, summary,
+sim_drift records — to DIR/<run-id>.jsonl; render it with
+``python -m flexflow_tpu.apps.report``).  Data is synthetic random token
+pairs (the reference initializes its word tensors with constants,
+nmt/rnn.cu:89-126).
 """
 
 from __future__ import annotations
@@ -60,6 +64,10 @@ def parse_args(argv) -> RnnConfig:
             cfg.print_intermediates = True
         elif a == "--dry-compile":
             cfg.dry_compile = True
+        elif a in ("-obs-dir", "--obs-dir"):
+            cfg.obs_dir = val()
+        elif a in ("-run-id", "--run-id"):
+            cfg.run_id = val()
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg

@@ -1,0 +1,36 @@
+"""Run-telemetry report CLI — the reader for the obs record schema.
+
+    python -m flexflow_tpu.apps.report <run.jsonl> [more.jsonl ...]
+
+Renders a run's JSONL event stream (FFConfig.obs_dir / RunLog output, a
+search-trace artifact, or a bench log) into the summary tables humans read
+today: training step/loss/throughput, search best-cost trajectory with
+acceptance stats and the winning strategy's per-op cost breakdown, audit
+and bench records.  Several files render as one merged stream (e.g. a fit
+log plus the search trace that produced its strategy).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None, log=print) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths or "-h" in argv or "--help" in argv:
+        log(__doc__.strip())
+        return 0 if paths or "-h" in argv or "--help" in argv else 2
+    from flexflow_tpu.obs import read_events
+    from flexflow_tpu.obs.report import render
+
+    events = []
+    for p in paths:
+        events.extend(read_events(p))
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    log(render(events))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

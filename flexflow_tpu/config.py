@@ -42,6 +42,13 @@ class FFConfig:
     param_dtype: str = "float32"
     seed: int = 0
     num_classes: int = 1000
+    # run telemetry (obs subsystem): when obs_dir is set, every surface
+    # (fit / search / bench) appends structured JSONL records to
+    # <obs_dir>/<run_id>.jsonl; unset = telemetry fully disabled (the step
+    # loop pays a single predicate check).  run_id defaults to a fresh
+    # time+pid id; set it to join several processes into one stream.
+    obs_dir: str = ""
+    run_id: str = ""
 
     strategies: Strategy = dataclasses.field(default_factory=Strategy)
 
@@ -63,7 +70,7 @@ class FFConfig:
         """Parse the reference's flag set (cnn.cc:539-582): -e/--epochs,
         -b/--batch-size, --lr, --wd, -p/--print-freq, -d/--dataset,
         -s/--strategy, plus TPU-native extras (--dtype, --iters, --seed,
-        --profiling)."""
+        --profiling, -obs-dir/-run-id for the run-telemetry JSONL)."""
         from flexflow_tpu.utils.flags import flag_stream
 
         cfg = cls()
@@ -98,6 +105,10 @@ class FFConfig:
                 cfg.profiling = True
             elif a == "--trace-dir":
                 cfg.trace_dir = val()
+            elif a in ("-obs-dir", "--obs-dir"):
+                cfg.obs_dir = val()
+            elif a in ("-run-id", "--run-id"):
+                cfg.run_id = val()
             elif a == "--ckpt-dir":
                 cfg.ckpt_dir = val()
             elif a == "--ckpt-freq":

@@ -180,6 +180,10 @@ class FFModel:
         from flexflow_tpu.strategy import Strategy
 
         remapped = Strategy()
+        # sidecar blocks (pipeline schedule, simulator prediction) ride
+        # along — they describe the plan, not any device-ordinal entry
+        remapped.pipeline = self.config.strategies.pipeline
+        remapped.predicted = self.config.strategies.predicted
         for name, pc in self.config.strategies.items():
             if tuple(sorted(pc.devices)) == canon:
                 remapped[name] = ParallelConfig(pc.dims, canon)
@@ -1159,7 +1163,21 @@ class FFModel:
 
         import jax
 
+        from flexflow_tpu import obs
+
         num_iterations = num_iterations or self.config.num_iterations
+        # run telemetry (obs subsystem): a live JSONL sink when
+        # config.obs_dir is set, else the shared no-op NULL — the step
+        # loop below pays one predicate check per iteration when disabled
+        olog = obs.from_config(
+            self.config, surface="fit",
+            meta={"model": type(self).__name__,
+                  "layers": len(self.layers),
+                  "devices": self.machine.num_devices,
+                  "batch_size": self.config.batch_size,
+                  "iterations": num_iterations,
+                  "compute_dtype": self.config.compute_dtype,
+                  "strategy_ops": len(self.config.strategies)})
 
         if getattr(self.config, "dry_compile", False):
             # DISABLE_COMPUTATION analog (ops.h:19): run the whole graph/
@@ -1168,9 +1186,16 @@ class FFModel:
             # execute nothing (the train state enters lowering as avals).
             from flexflow_tpu.utils.profiling import normalize_cost_analysis
 
+            t0 = time.perf_counter()
             compiled = self.compile_train_step(*next(data_iter))
             cost = normalize_cost_analysis(compiled)
             mem = compiled.memory_analysis()
+            olog.event("compile", seconds=time.perf_counter() - t0,
+                       flops=float(cost.get("flops", 0.0)),
+                       bytes_accessed=float(cost.get("bytes accessed",
+                                                     0.0)),
+                       dry=True)
+            olog.close()
             log(f"dry-compile ok: {len(self.layers)} layers, "
                 f"flops/step = {cost.get('flops', 0.0):.3e}, "
                 f"argument bytes = "
@@ -1189,8 +1214,11 @@ class FFModel:
             from flexflow_tpu.utils import checkpoint as ckpt
 
             if ckpt.latest_step(ckpt_dir) is not None:
+                t0 = time.perf_counter()
                 start_iter, params, state, opt_state = \
                     ckpt.restore_checkpoint(ckpt_dir, self)
+                olog.event("checkpoint_restore", step=start_iter,
+                           seconds=time.perf_counter() - t0, dir=ckpt_dir)
                 resumed = True
                 opt_state = opt_state or self.init_opt_state(params)
                 saved = ckpt.load_strategy(ckpt_dir)
@@ -1216,7 +1244,19 @@ class FFModel:
 
             trace_ctx = trace(self.config.trace_dir)
 
+        # losses accumulate as raw device arrays — converted to floats in
+        # ONE bulk transfer after the timed loop (no per-step sync, and
+        # callers get plain numbers instead of pinned device buffers)
         losses = []
+        # obs: host-side per-step wall clock only — tick() never syncs,
+        # and the per-step records are written AFTER the timed loop, so
+        # the device pipeline is unperturbed.  Disabled: clock is None
+        # and the loop pays one predicate check.
+        clock = None
+        if olog.enabled:
+            from flexflow_tpu.utils.profiling import StepClock
+
+            clock = StepClock()
         start = time.perf_counter()
         loss = None
         with trace_ctx:
@@ -1230,23 +1270,39 @@ class FFModel:
                 params, state, opt_state, loss = step(
                     params, state, opt_state, *batch)
                 losses.append(loss)
+                if clock is not None:
+                    clock.tick()
                 if self.config.print_freq \
                         and (it + 1) % self.config.print_freq == 0:
                     log(f"iter {it + 1}: loss = {float(loss):.4f}")
                 if ckpt_dir and ckpt_freq and (it + 1) % ckpt_freq == 0 \
                         and it + 1 < num_iterations:
+                    t0 = time.perf_counter()
                     ckpt.save_checkpoint(ckpt_dir, it + 1, params, state,
                                          opt_state, self.config.strategies)
+                    olog.event("checkpoint_save", step=it + 1,
+                               seconds=time.perf_counter() - t0,
+                               dir=ckpt_dir)
             if loss is not None:
                 float(loss)
             elapsed = time.perf_counter() - start
         if ckpt_dir and start_iter < num_iterations:
+            t0 = time.perf_counter()
             ckpt.save_checkpoint(ckpt_dir, num_iterations, params, state,
                                  opt_state, self.config.strategies)
+            olog.event("checkpoint_save", step=num_iterations,
+                       seconds=time.perf_counter() - t0, dir=ckpt_dir)
+        # the one bulk device->host transfer of the whole loss history
+        losses = [float(l) for l in jax.device_get(losses)]
         n_timed = num_iterations - warmup
         throughput = (n_timed * self.config.batch_size / elapsed
                       if elapsed > 0 and n_timed > 0 else 0.0)
         log(f"time = {elapsed:.4f}s, tp = {throughput:.2f} images/s")
+        if olog.enabled:
+            self._emit_fit_records(olog, clock, losses, start_iter, warmup,
+                                   num_iterations, elapsed, throughput,
+                                   step, params, state, opt_state,
+                                   batch if losses else None)
         if self.config.profiling:
             # Flag-gated profiling report (reference: per-task cudaEvent ms
             # when `profiling` is set, conv_2d.cu:514-545).  Lead with the
@@ -1272,11 +1328,82 @@ class FFModel:
                 except Exception as e:
                     log(f"step roofline unavailable: {e}")
             log(OpProfiler(self).report())
+        olog.close()
         return {
             "params": params, "state": state,
-            "loss": [float(l) for l in losses],
+            "loss": losses,
             "elapsed_s": elapsed, "images_per_sec": throughput,
+            "run_id": olog.run_id, "obs_path": olog.path,
         }
+
+    def _emit_fit_records(self, olog, clock, losses, start_iter, warmup,
+                          num_iterations, elapsed, throughput,
+                          step, params, state, opt_state, batch):
+        """Write the fit surface's obs records (compile, per-step, summary,
+        sim_drift).  Runs strictly AFTER the timed loop — the only
+        in-loop obs cost is StepClock.tick()."""
+        bsz = self.config.batch_size
+        # one-time compile record: the first call's wall time is the
+        # host-observable compile cost (trace + partition + XLA compile +
+        # one step); post-fusion FLOPs/bytes come from the compiled
+        # executable's cost analysis (lowering hits jit's trace cache)
+        compile_rec = {"seconds": clock.deltas[0] if clock.deltas else 0.0}
+        if batch is not None:
+            try:
+                from flexflow_tpu.utils.profiling import \
+                    normalize_cost_analysis
+
+                ca = normalize_cost_analysis(
+                    step.lower(params, state, opt_state, *batch).compile())
+                compile_rec["flops"] = float(ca.get("flops", 0.0))
+                compile_rec["bytes_accessed"] = float(
+                    ca.get("bytes accessed", 0.0))
+            except Exception as e:  # cost analysis is backend-optional
+                compile_rec["cost_analysis_error"] = str(e)
+        olog.event("compile", **compile_rec)
+        for i, dt in enumerate(clock.deltas):
+            it = start_iter + i
+            olog.event("step", step=it + 1, wall_ms=dt * 1e3,
+                       loss=losses[i] if i < len(losses) else None,
+                       images_per_sec=bsz / dt if dt > 0 else 0.0,
+                       timed=it >= warmup)
+        olog.event("summary", iterations=num_iterations - start_iter,
+                   warmup=warmup - start_iter, elapsed_s=elapsed,
+                   images_per_sec=throughput,
+                   final_loss=losses[-1] if losses else None)
+        n_timed = num_iterations - warmup
+        if self.config.strategies and n_timed > 0 and elapsed > 0:
+            self._emit_sim_drift(olog, elapsed / n_timed)
+
+    def _emit_sim_drift(self, olog, measured_step_s):
+        """The simulator-calibration gauge: measured step time vs the
+        simulator's prediction for the loaded strategy.  Prefers the
+        prediction the search artifact carries (``__predicted__``, written
+        by apps/search.py); falls back to simulating this model's
+        strategy with the analytic cost model.  value = measured/predicted
+        — >1 means the simulator is optimistic (the round-4
+        transformer_2x4 falsification was this signal at ~8x on comm
+        volume); drift-driven recalibration reads this record."""
+        pred = getattr(self.config.strategies, "predicted", None)
+        predicted_s, source = None, None
+        if pred and pred.get("best_time_s"):
+            predicted_s, source = float(pred["best_time_s"]), "artifact"
+        else:
+            try:
+                from flexflow_tpu.sim.search import StrategySearch
+
+                ss = StrategySearch(self, machine=self.machine)
+                predicted_s = ss.simulate(
+                    ss.assignment_for(self.config.strategies))
+                source = "analytic"
+            except Exception as e:
+                olog.event("sim_drift_unavailable", error=str(e))
+                return
+        if predicted_s and predicted_s > 0:
+            olog.event("sim_drift", name="sim_drift",
+                       value=measured_step_s / predicted_s,
+                       predicted_s=predicted_s,
+                       measured_s=measured_step_s, source=source)
 
     def summary(self) -> str:
         lines = [f"FFModel: {len(self.layers)} layers, "

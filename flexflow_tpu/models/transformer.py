@@ -45,6 +45,9 @@ class TransformerConfig:
     params_init: str = "default"
     print_intermediates: bool = False
     dry_compile: bool = False
+    # run telemetry (forwarded to FFConfig; obs subsystem)
+    obs_dir: str = ""
+    run_id: str = ""
 
 
 class TransformerLM(FFModel):
@@ -66,6 +69,8 @@ class TransformerLM(FFModel):
             params_init=self.t.params_init,
             print_intermediates=self.t.print_intermediates,
             dry_compile=self.t.dry_compile,
+            obs_dir=self.t.obs_dir,
+            run_id=self.t.run_id,
             strategies=strategies or Strategy(),
         )
         super().__init__(ff_cfg, machine)

@@ -308,4 +308,55 @@ double ffsim_mcmc(void* handle, int32_t* assign, int64_t iters, double beta,
   return best_t;
 }
 
+// Chunk-resumable Metropolis MCMC with acceptance accounting (the obs
+// subsystem's trajectory source).  The caller owns the chain: `cur` and
+// `best` are the current and best assignments, `times[0]`/`times[1]` their
+// simulated costs (pass times[0] < 0 on the first chunk to compute it).
+// Runs `iters` proposals continuing that chain, writes the advanced state
+// back, and adds the chunk's counts to stats[0] (accepted moves) and
+// stats[1] (evaluated proposals; self/singleton proposals are skipped and
+// not counted).  Semantics per proposal are identical to ffsim_mcmc; a
+// chunked run differs from one long call only in re-seeding per chunk.
+// Returns the best cost.
+double ffsim_mcmc_run(void* handle, int32_t* cur, int32_t* best,
+                      double* times, int64_t iters, double beta,
+                      uint64_t seed, int64_t* stats) {
+  Simulator* sim = (Simulator*)handle;
+  size_t n = sim->ops.size();
+  std::vector<int> c(n), b(n);
+  for (size_t i = 0; i < n; i++) { c[i] = cur[i]; b[i] = best[i]; }
+  std::mt19937_64 rng(seed);
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+  double cur_t = times[0] >= 0.0 ? times[0] : sim->simulate(c);
+  double best_t = times[1] >= 0.0 ? times[1] : cur_t;
+  int64_t accepted = 0, proposed = 0;
+  for (int64_t it = 0; it < iters; it++) {
+    size_t o = rng() % n;
+    size_t nc = sim->ops[o].configs.size();
+    if (nc <= 1) continue;
+    int old = c[o];
+    int prop = (int)(rng() % nc);
+    if (prop == old) continue;
+    proposed++;
+    c[o] = prop;
+    double t = sim->simulate(c);
+    if (t < cur_t || unif(rng) < std::exp(-beta * (t - cur_t))) {
+      accepted++;
+      cur_t = t;
+      if (t < best_t) {
+        best_t = t;
+        b = c;
+      }
+    } else {
+      c[o] = old;
+    }
+  }
+  for (size_t i = 0; i < n; i++) { cur[i] = c[i]; best[i] = b[i]; }
+  times[0] = cur_t;
+  times[1] = best_t;
+  stats[0] += accepted;
+  stats[1] += proposed;
+  return best_t;
+}
+
 }  // extern "C"

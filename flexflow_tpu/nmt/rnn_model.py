@@ -56,6 +56,9 @@ class RnnConfig:
     params_init: str = "default"
     print_intermediates: bool = False
     dry_compile: bool = False
+    # run telemetry (forwarded to FFConfig; obs subsystem)
+    obs_dir: str = ""
+    run_id: str = ""
 
     @property
     def chunks_per_seq(self) -> int:
@@ -137,6 +140,8 @@ class RnnModel(FFModel):
             params_init=self.rnn.params_init,
             print_intermediates=self.rnn.print_intermediates,
             dry_compile=self.rnn.dry_compile,
+            obs_dir=self.rnn.obs_dir,
+            run_id=self.rnn.run_id,
             strategies=strategies,
         )
         super().__init__(ff_cfg, machine)

@@ -1,0 +1,199 @@
+"""Unified run-telemetry subsystem: one structured, machine-readable event
+stream for training, strategy search, and audit/bench.
+
+The reference FlexFlow's only instruments are per-task cudaEvent prints and
+Legion ``-lg:prof`` traces (SURVEY §5); this repo already measures more
+(OpProfiler, XProf traces, rooflines, the compiled-HLO collective audit)
+but each instrument spoke its own dialect — free-form ``fit()`` prints, a
+single final dict from ``StrategySearch.search()``, a bench JSON line
+fished out of mixed stdout.  This package gives them ONE record schema:
+
+  * every record is one JSON object per line (JSONL), stamped with the
+    run id and a host wall-clock timestamp:
+    ``{"run": <id>, "ts": <epoch s>, "kind": <str>, ...}``;
+  * ``kind`` names the record family.  Core families: ``run_start``,
+    ``counter``, ``gauge``, ``timer``, plus the surface records —
+    ``compile`` / ``step`` / ``summary`` / ``checkpoint_save`` /
+    ``checkpoint_restore`` / ``sim_drift`` (training, model.py::fit),
+    ``search_space`` / ``search_chunk`` / ``search_result`` /
+    ``search_breakdown`` / ``pipeline_candidate`` / ``pipeline_decision``
+    (sim/search.py), and ``hlo_audit`` / ``bench`` (audit/bench);
+  * :class:`RunLog` is the thread-safe sink; :class:`NullRunLog` (the
+    module-level ``NULL``) is the disabled sink whose every method is a
+    no-op, so instrumented code pays one predicate/attribute check when
+    ``FFConfig.obs_dir`` is unset;
+  * :func:`read_events` is the reader; ``apps/report.py`` renders a run
+    back into the summary tables humans read today.
+
+Telemetry is strictly OFF the device hot path: records carry host-side
+timestamps only and no instrumentation site may introduce a device sync
+(``fit()`` buffers per-step wall times and writes records after the timed
+loop).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+SCHEMA_VERSION = 1
+
+
+def new_run_id() -> str:
+    """Sortable, collision-resistant run id: wall time + pid + 2 random
+    bytes (two runs in the same second on the same host stay distinct)."""
+    return "%s-%x-%s" % (time.strftime("%Y%m%d-%H%M%S"), os.getpid(),
+                         os.urandom(2).hex())
+
+
+class NullRunLog:
+    """The disabled sink: every method is a no-op and ``enabled`` is
+    False, so hot-path call sites cost one attribute check.  A single
+    module-level instance (``NULL``) is shared."""
+
+    enabled = False
+    path = None
+    run_id = None
+
+    def event(self, kind: str, **fields) -> None:
+        pass
+
+    def counter(self, name: str, value: float = 1, **fields) -> None:
+        pass
+
+    def gauge(self, name: str, value: float, **fields) -> None:
+        pass
+
+    def timer(self, name: str, **fields):
+        return contextlib.nullcontext()
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __bool__(self) -> bool:
+        return False
+
+
+NULL = NullRunLog()
+
+
+class RunLog:
+    """Thread-safe JSONL event sink.
+
+    One instance == one event stream (usually one file per run id; several
+    surfaces of the same process — fit, search, bench — may share it, the
+    ``surface`` field keeps them separable).  Writes are line-buffered and
+    serialized under a lock, so concurrent emitters (e.g. data-loader
+    threads) never interleave partial lines."""
+
+    enabled = True
+
+    def __init__(self, path: str, run_id: Optional[str] = None,
+                 surface: str = "", meta: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.run_id = run_id or new_run_id()
+        self.surface = surface
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "a")
+        self.event("run_start", schema=SCHEMA_VERSION,
+                   **(dict(meta) if meta else {}))
+
+    # -- core emitters --------------------------------------------------
+
+    def event(self, kind: str, **fields) -> None:
+        rec = {"run": self.run_id, "ts": time.time(), "kind": kind}
+        if self.surface:
+            rec["surface"] = self.surface
+        rec.update(fields)
+        line = json.dumps(rec, default=_jsonable)
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def counter(self, name: str, value: float = 1, **fields) -> None:
+        self.event("counter", name=name, value=value, **fields)
+
+    def gauge(self, name: str, value: float, **fields) -> None:
+        self.event("gauge", name=name, value=value, **fields)
+
+    @contextlib.contextmanager
+    def timer(self, name: str, **fields):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.event("timer", name=name,
+                       seconds=time.perf_counter() - t0, **fields)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _jsonable(o):
+    """Last-resort encoder: numpy/jax scalars -> python numbers, tuples of
+    them inside payloads -> lists, everything else -> repr (a telemetry
+    write must never raise into the instrumented surface)."""
+    try:
+        return o.item()  # numpy / jax scalar
+    except AttributeError:
+        pass
+    if isinstance(o, (set, frozenset)):
+        return sorted(o)
+    return repr(o)
+
+
+def from_config(config, surface: str = "",
+                meta: Optional[Dict[str, Any]] = None):
+    """The one gate instrumented surfaces call: a live :class:`RunLog`
+    when ``config.obs_dir`` is set (file ``<obs_dir>/<run_id>.jsonl``),
+    else the shared ``NULL`` sink.  ``config.run_id`` (when set) names the
+    run so several processes/surfaces can append to one stream."""
+    obs_dir = getattr(config, "obs_dir", "") or ""
+    if not obs_dir:
+        return NULL
+    run_id = getattr(config, "run_id", "") or new_run_id()
+    return RunLog(os.path.join(obs_dir, f"{run_id}.jsonl"),
+                  run_id=run_id, surface=surface, meta=meta)
+
+
+def read_events(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield the records of a run JSONL in file order.  Malformed lines
+    (a crashed writer's torn tail) are skipped, not raised — readers must
+    be able to render a partial run."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                yield rec

@@ -1,0 +1,232 @@
+"""Render a run-telemetry JSONL (obs record schema) back into the summary
+tables humans read today — the reader side of the obs subsystem.
+
+``python -m flexflow_tpu.apps.report <run.jsonl>`` is the CLI wrapper.
+Sections are emitted only for the record kinds actually present, so one
+renderer serves fit runs, search runs, bench runs, and mixed streams.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values: List[float], width: int = 40) -> str:
+    """Compact ascii curve of ``values`` (downsampled to ``width``)."""
+    if not values:
+        return ""
+    if len(values) > width:
+        step = len(values) / width
+        values = [values[int(i * step)] for i in range(width)]
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK[0] * len(values)
+    return "".join(
+        _SPARK[min(int((v - lo) / (hi - lo) * (len(_SPARK) - 1)),
+                   len(_SPARK) - 1)] for v in values)
+
+
+def _fmt_s(s: float) -> str:
+    return f"{s * 1e3:.3f} ms" if s < 1.0 else f"{s:.3f} s"
+
+
+def _header(events: List[Dict]) -> List[str]:
+    runs = sorted({e.get("run") for e in events if e.get("run")})
+    surfaces = sorted({e.get("surface") for e in events
+                       if e.get("surface")})
+    ts = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
+    lines = [f"run: {', '.join(str(r) for r in runs) or '?'}"]
+    if surfaces:
+        lines.append(f"surfaces: {', '.join(surfaces)}")
+    if ts:
+        lines.append(f"records: {len(events)}, span: "
+                     f"{max(ts) - min(ts):.1f}s")
+    for e in events:
+        if e.get("kind") == "run_start":
+            extras = {k: v for k, v in e.items()
+                      if k not in ("run", "ts", "kind", "surface",
+                                   "schema")}
+            if extras:
+                lines.append("meta: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(extras.items())))
+    return lines
+
+
+def _fit_section(events: List[Dict]) -> List[str]:
+    steps = [e for e in events if e.get("kind") == "step"]
+    compiles = [e for e in events if e.get("kind") == "compile"]
+    summaries = [e for e in events if e.get("kind") == "summary"]
+    ckpts = [e for e in events
+             if e.get("kind") in ("checkpoint_save", "checkpoint_restore")]
+    drift = [e for e in events if e.get("kind") == "sim_drift"]
+    if not (steps or compiles or summaries):
+        return []
+    lines = ["== training =="]
+    for c in compiles:
+        parts = [f"compile: {c.get('seconds', 0.0):.2f}s"]
+        if c.get("flops"):
+            parts.append(f"{c['flops']:.3e} FLOPs/step")
+        if c.get("bytes_accessed"):
+            parts.append(f"{c['bytes_accessed']:.3e} bytes/step")
+        lines.append("  " + ", ".join(parts))
+    if steps:
+        walls = [e["wall_ms"] for e in steps if "wall_ms" in e]
+        losses = [e["loss"] for e in steps if e.get("loss") is not None]
+        lines.append(
+            f"  steps: {len(steps)}"
+            + (f", wall ms min/mean/max = {min(walls):.2f}/"
+               f"{sum(walls) / len(walls):.2f}/{max(walls):.2f}"
+               if walls else ""))
+        if losses:
+            lines.append(f"  loss: first {losses[0]:.4f} -> "
+                         f"final {losses[-1]:.4f}   "
+                         f"{_spark([float(l) for l in losses])}")
+    for s in summaries:
+        lines.append(
+            f"  summary: {s.get('iterations', '?')} iters, "
+            f"elapsed {s.get('elapsed_s', 0.0):.4f}s, "
+            f"tp {s.get('images_per_sec', 0.0):.2f} images/s")
+    for c in ckpts:
+        lines.append(f"  {c['kind']}: step {c.get('step', '?')} "
+                     f"({c.get('seconds', 0.0):.3f}s)")
+    for d in drift:
+        lines.append(
+            f"  sim_drift: predicted {_fmt_s(d.get('predicted_s', 0.0))} "
+            f"vs measured {_fmt_s(d.get('measured_s', 0.0))} "
+            f"-> ratio {d.get('value', 0.0):.3f} "
+            f"[{d.get('source', '?')}]")
+    return lines
+
+
+def _search_section(events: List[Dict]) -> List[str]:
+    space = [e for e in events if e.get("kind") == "search_space"]
+    chunks = [e for e in events if e.get("kind") == "search_chunk"]
+    results = [e for e in events if e.get("kind") == "search_result"]
+    breakdown = [e for e in events if e.get("kind") == "search_breakdown"]
+    pipes = [e for e in events if e.get("kind") == "pipeline_decision"]
+    if not (space or chunks or results):
+        return []
+    lines = ["== strategy search =="]
+    for s in space:
+        lines.append(
+            f"  space: {s.get('ops', '?')} ops, "
+            f"{s.get('candidates', '?')} candidates "
+            f"({s.get('axis_options_pruned', 0)} axis options pruned, "
+            f"{s.get('mem_rejected', 0)} HBM-rejected)")
+    if chunks:
+        curve = [c["best_time_s"] for c in chunks if "best_time_s" in c]
+        acc = sum(c.get("accepted", 0) for c in chunks)
+        prop = sum(c.get("proposed", 0) for c in chunks)
+        pps = [c["proposals_per_sec"] for c in chunks
+               if c.get("proposals_per_sec")]
+        if curve:
+            lines.append(
+                f"  best-cost curve ({len(curve)} chunks): "
+                f"{_fmt_s(curve[0])} -> {_fmt_s(curve[-1])}   "
+                f"{_spark(curve)}")
+        lines.append(
+            f"  acceptance: {acc}/{prop} "
+            f"({100.0 * acc / prop if prop else 0.0:.1f}%)"
+            + (f", {sum(pps) / len(pps):,.0f} proposals/s" if pps else ""))
+    for r in results:
+        lines.append(
+            f"  result: dp {_fmt_s(r.get('dp_time_s', 0.0))}, "
+            f"best {_fmt_s(r.get('best_time_s', 0.0))} "
+            f"({r.get('speedup_vs_dp', 0.0):.3f}x vs DP)")
+        cache = r.get("cost_cache")
+        if cache:
+            tot = cache.get("hits", 0) + cache.get("misses", 0)
+            lines.append(
+                f"  cost cache: {cache.get('hits', 0)}/{tot} hits "
+                f"({100.0 * cache.get('hits', 0) / tot if tot else 0.0:.1f}%)")
+    for b in breakdown:
+        ops = sorted(b.get("ops", []),
+                     key=lambda o: -(o.get("compute_s", 0.0)
+                                     + o.get("collective_s", 0.0)))
+        lines.append(f"  winning strategy, per-op cost "
+                     f"(top {min(len(ops), 12)} of {len(ops)}):")
+        lines.append(f"    {'op':<18s} {'kind':<14s} {'grid':<14s} "
+                     f"{'compute':>10s} {'collective':>10s}")
+        for o in ops[:12]:
+            lines.append(
+                f"    {str(o.get('op', '?')):<18s} "
+                f"{str(o.get('kind', '?')):<14s} "
+                f"{str(tuple(o.get('dims', ()))):<14s} "
+                f"{_fmt_s(o.get('compute_s', 0.0)):>10s} "
+                f"{_fmt_s(o.get('collective_s', 0.0)):>10s}")
+        if b.get("opt_stream_s"):
+            lines.append(f"    optimizer param stream: "
+                         f"{_fmt_s(b['opt_stream_s'])}")
+    for p in pipes:
+        lines.append(
+            f"  pipeline: {'ACCEPT' if p.get('accepted') else 'REJECT'}"
+            + (f" S={p['best'].get('stages')} "
+               f"M={p['best'].get('microbatches')} "
+               f"tp={p['best'].get('tp')}" if p.get("best") else "")
+            + f" (ref {_fmt_s(p.get('reference_time_s', 0.0))})")
+    return lines
+
+
+def _audit_bench_section(events: List[Dict]) -> List[str]:
+    audits = [e for e in events if e.get("kind") == "hlo_audit"]
+    benches = [e for e in events if e.get("kind") == "bench"]
+    if not (audits or benches):
+        return []
+    lines = ["== audit / bench =="]
+    for a in audits:
+        lines.append(
+            f"  hlo_audit[{a.get('plan', '?')}]: "
+            f"searched {a.get('searched_cross_mb', '?')} MB cross-tier "
+            f"vs DP {a.get('dp_cross_mb', '?')} MB -> "
+            f"{'CONSISTENT' if a.get('consistent') else 'CONTRADICTED'}")
+    for b in benches:
+        lines.append(
+            f"  bench: {b.get('metric', '?')} = {b.get('value', '?')} "
+            f"{b.get('unit', '')} (vs_baseline {b.get('vs_baseline', '?')}"
+            + (f", mfu {b['mfu']}" if b.get("mfu") is not None else "")
+            + ")")
+    return lines
+
+
+def _misc_section(events: List[Dict]) -> List[str]:
+    known = {"run_start", "compile", "step", "summary", "checkpoint_save",
+             "checkpoint_restore", "sim_drift", "search_space",
+             "search_chunk", "search_result", "search_breakdown",
+             "pipeline_candidate", "pipeline_decision", "hlo_audit",
+             "bench"}
+    lines = []
+    for e in events:
+        kind = e.get("kind")
+        if kind in known:
+            continue
+        if kind == "counter":
+            lines.append(f"  counter {e.get('name')}: {e.get('value')}")
+        elif kind == "gauge":
+            lines.append(f"  gauge {e.get('name')}: {e.get('value')}")
+        elif kind == "timer":
+            lines.append(f"  timer {e.get('name')}: "
+                         f"{_fmt_s(e.get('seconds', 0.0))}")
+        else:
+            body = {k: v for k, v in e.items()
+                    if k not in ("run", "ts", "surface")}
+            lines.append(f"  {body}")
+    return (["== other records =="] + lines) if lines else []
+
+
+def render(events: Iterable[Dict]) -> str:
+    """One human-readable report of a run's event stream."""
+    events = list(events)
+    if not events:
+        return "(empty run log)"
+    sections = [_header(events), _fit_section(events),
+                _search_section(events), _audit_bench_section(events),
+                _misc_section(events)]
+    return "\n".join("\n".join(s) for s in sections if s)
+
+
+def render_file(path: str) -> str:
+    from flexflow_tpu.obs import read_events
+
+    return render(read_events(path))

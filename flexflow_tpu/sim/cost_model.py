@@ -150,6 +150,11 @@ class MeasuredCostModel:
         # skew the per-kind median toward repeated shapes (round-3 ADVICE)
         self._kind_seen: set = set()
         self._cache: Dict[str, float] = {}
+        # candidate-cache accounting (obs subsystem): op_cost lookups
+        # served from the measurement cache vs timed fresh — the search's
+        # search_result record reports the hit rate
+        self.cache_hits = 0
+        self.cache_misses = 0
         # entries written by other timing protocols: never used for lookup,
         # but preserved verbatim on save so downgrading to an older binary
         # does not require re-measuring everything
@@ -176,6 +181,7 @@ class MeasuredCostModel:
     def op_cost(self, op: Op, pc: ParallelConfig) -> float:
         key = self._key(op, pc)
         if key in self._cache:
+            self.cache_hits += 1
             t = self._cache[key]
             # cached measurements feed the kind anchor too (once per key),
             # so a fully cache-served search still ranks unmeasurable
@@ -185,6 +191,7 @@ class MeasuredCostModel:
                 self._kind_ratios.setdefault(type(op).__name__, []).append(
                     t / max(self.fallback.op_cost(op, pc), 1e-12))
             return t
+        self.cache_misses += 1
         t = self._measure(op, pc)
         if t is None:
             # Unmeasurable shard (e.g. an uneven spatial split that

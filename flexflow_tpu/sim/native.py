@@ -41,6 +41,14 @@ def _load():
                                ctypes.POINTER(ctypes.c_int32),
                                ctypes.c_int64, ctypes.c_double,
                                ctypes.c_uint64]
+    lib.ffsim_mcmc_run.restype = ctypes.c_double
+    lib.ffsim_mcmc_run.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int32),
+                                   ctypes.POINTER(ctypes.c_int32),
+                                   ctypes.POINTER(ctypes.c_double),
+                                   ctypes.c_int64, ctypes.c_double,
+                                   ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_int64)]
     _lib = lib
     return lib
 
@@ -80,6 +88,28 @@ class NativeSimulator:
             self._handle, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             iters, beta, seed)
         return a.tolist(), t
+
+    def mcmc_chunk(self, cur, best, cur_t, best_t, iters: int,
+                   beta: float = 5e3, seed: int = 0):
+        """Advance a caller-owned MCMC chain by ``iters`` proposals (the
+        chunk-resumable path behind the obs trajectory records).  Pass
+        ``cur_t < 0`` on the first chunk to have the native side compute
+        it.  Returns (cur, best, cur_t, best_t, accepted, proposed)."""
+        lib = _load()
+        c = np.ascontiguousarray(cur, dtype=np.int32).copy()
+        b = np.ascontiguousarray(best, dtype=np.int32).copy()
+        assert len(c) == self.n_ops and len(b) == self.n_ops
+        times = np.array([cur_t, best_t], dtype=np.float64)
+        stats = np.zeros(2, dtype=np.int64)
+        lib.ffsim_mcmc_run(
+            self._handle,
+            c.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            times.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            iters, beta, seed,
+            stats.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return (c.tolist(), b.tolist(), float(times[0]), float(times[1]),
+                int(stats[0]), int(stats[1]))
 
     def __del__(self):
         if getattr(self, "_handle", None):

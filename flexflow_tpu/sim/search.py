@@ -421,15 +421,22 @@ class StrategySearch:
     def __init__(self, model: FFModel, machine: Optional[MachineModel] = None,
                  cost_model=None,
                  max_per_axis: Optional[Dict[str, int]] = None,
-                 placement: bool = True):
+                 placement: bool = True, obs=None):
         """``placement=False`` restricts candidates to canonical device
         lists (dims-only search, the round-1 behavior) — kept for A/B
-        comparison of the placement dimension's value."""
+        comparison of the placement dimension's value.  ``obs`` is an
+        optional :class:`flexflow_tpu.obs.RunLog`; the build, search and
+        pipeline proposal emit structured records into it (search_space /
+        search_chunk / search_result / search_breakdown /
+        pipeline_candidate / pipeline_decision)."""
+        from flexflow_tpu import obs as _obs
+
         self.model = model
         self.machine = machine or model.machine
         self.cost_model = cost_model or AnalyticCostModel()
         self.max_per_axis = max_per_axis
         self.placement = placement
+        self.obs = obs or _obs.NULL
         n_dev = self.machine.num_devices
         self.inputs = [_InputSource(t, n_dev)
                        for t in getattr(model, "_inputs", [])]
@@ -594,6 +601,15 @@ class StrategySearch:
             "model)", self.stats["ops"], self.stats["candidates"],
             self.stats.get("axis_options_pruned", 0),
             self.stats["mem_rejected"], hbm_cap / 1e9)
+        self.obs.event(
+            "search_space", ops=self.stats["ops"],
+            candidates=self.stats["candidates"],
+            axis_options_pruned=self.stats.get("axis_options_pruned", 0),
+            mem_rejected=self.stats["mem_rejected"],
+            devices=n_dev,
+            ici_group=topo.devices_per_ici_group,
+            placement=self.placement,
+            cost_model=type(self.cost_model).__name__)
         dbls = [topo.ici_bandwidth, topo.dcn_bandwidth, topo.ici_latency]
         dbls.extend(pbytes)
         dbls.extend(costs)
@@ -861,6 +877,9 @@ class StrategySearch:
                         "bubble_factor": (M + S - 1) / M,
                         "comm_s": comm, "tp_comm_s": tp_comm,
                         "param_sync_s": sync})
+                    self.obs.event("pipeline_candidate",
+                                   reference_time_s=t_ref,
+                                   **candidates[-1])
                     logger(
                         "pipeline candidate S=%d M=%d tp=%d: %.4fs "
                         "(makespan %.4fs x %.2f bubble + %.4fs comm + "
@@ -874,6 +893,12 @@ class StrategySearch:
                   f"S={best['stages']} M={best['microbatches']} "
                   f"tp={best['tp']} {best['time_s']:.4f}s"
                   if best else "none", t_ref))
+        self.obs.event(
+            "pipeline_decision", accepted=accepted,
+            reference_time_s=t_ref,
+            best=({"stages": best["stages"],
+                   "microbatches": best["microbatches"], "tp": best["tp"],
+                   "time_s": best["time_s"]} if best else None))
         return {"candidates": candidates, "reference_time_s": t_ref,
                 "accepted": accepted,
                 "best": ({"stages": best["stages"],
@@ -881,20 +906,119 @@ class StrategySearch:
                           "tp": best["tp"]}
                          if accepted else None)}
 
+    def assignment_for(self, strategy) -> List[int]:
+        """Candidate index per op matching ``strategy``'s entries (ops the
+        strategy does not name take their DP default).  Raises KeyError
+        when a named entry is not among the op's candidates — such a pc is
+        one the search would never have emitted (the executor degrades
+        it), so simulating it would claim a cost the plan cannot have.
+        Used by fit()'s ``sim_drift`` fallback to price a loaded strategy
+        without re-searching."""
+        dp = self.dp_assignment()
+        out = []
+        for op, cands, dflt in zip(self.ops, self.candidates, dp):
+            pc = None if isinstance(op, _InputSource) \
+                else strategy.get(op.name)
+            if pc is None:
+                out.append(dflt)
+                continue
+            for i, c in enumerate(cands):
+                if c.dims == pc.dims and c.devices == pc.devices:
+                    out.append(i)
+                    break
+            else:
+                raise KeyError(
+                    f"strategy entry for {op.name!r} (dims {pc.dims}) is "
+                    f"not among its {len(cands)} search candidates")
+        return out
+
     def search(self, iters: int = 250_000, beta: float = 5e3,
-               seed: int = 0):
+               seed: int = 0, chunks: int = 25):
         """MCMC from the DP start point (reference: scripts/simulator.cc
-        :1427-1471). Returns (strategy, info)."""
+        :1427-1471).  The chain runs as up to ``chunks`` chain-continuing
+        native calls (ffsim_mcmc_run) so the trajectory is observable:
+        each chunk emits a ``search_chunk`` obs record (best-cost curve,
+        acceptance rate, proposals/sec) and the run closes with
+        ``search_result`` + ``search_breakdown`` records.  Per-proposal
+        semantics match the single-call native path (chunking only
+        re-seeds per chunk).  Returns (strategy, info); ``info["trace"]``
+        carries the per-chunk trajectory for programmatic callers."""
+        import time as _time
+
         dp = self.dp_assignment()
         dp_time = self.simulate(dp)
-        best, best_time = self.sim.mcmc(dp, iters=iters, beta=beta,
-                                        seed=seed)
-        best_time += self._opt_stream_s  # mcmc ranks raw makespans; the
-        # optimizer stream is assignment-invariant, so add it to both
+        chunks = max(1, min(int(chunks), max(iters, 1)))
+        cur, best = list(dp), list(dp)
+        cur_t = best_t = -1.0  # native computes the raw makespan lazily
+        trace = []
+        tot_acc = tot_prop = done = 0
+        for ci in range(chunks):
+            it_n = iters // chunks + (1 if ci < iters % chunks else 0)
+            if it_n <= 0:
+                continue
+            t0 = _time.perf_counter()
+            cur, best, cur_t, best_t, acc, prop = self.sim.mcmc_chunk(
+                cur, best, cur_t, best_t, it_n, beta=beta,
+                seed=seed * 1_000_003 + ci)
+            wall = _time.perf_counter() - t0
+            done += it_n
+            tot_acc += acc
+            tot_prop += prop
+            rec = {
+                "iters_done": done,
+                "best_time_s": best_t + self._opt_stream_s,
+                "cur_time_s": cur_t + self._opt_stream_s,
+                "accepted": acc, "proposed": prop,
+                "accept_rate": acc / prop if prop else 0.0,
+                "proposals_per_sec": prop / wall if wall > 0 else 0.0,
+                "wall_s": wall,
+            }
+            trace.append(rec)
+            self.obs.event("search_chunk", **rec)
+        if done == 0:  # iters <= 0: the DP start point is the answer
+            best, best_t = list(dp), self.sim.simulate(dp)
+        best_time = best_t + self._opt_stream_s  # the optimizer stream is
+        # assignment-invariant; the native chain ranks raw makespans
         info = {
             "dp_time": dp_time,
             "best_time": best_time,
             "speedup_vs_dp": dp_time / best_time if best_time else 1.0,
             "assignment": best,
+            "trace": trace,
+            "accept_rate": tot_acc / tot_prop if tot_prop else 0.0,
         }
+        result = {"dp_time_s": dp_time, "best_time_s": best_time,
+                  "speedup_vs_dp": info["speedup_vs_dp"], "iters": done,
+                  "accepted": tot_acc, "proposed": tot_prop,
+                  "accept_rate": info["accept_rate"], "seed": seed,
+                  "beta": beta}
+        if hasattr(self.cost_model, "cache_hits"):
+            result["cost_cache"] = {
+                "hits": self.cost_model.cache_hits,
+                "misses": self.cost_model.cache_misses}
+        self.obs.event("search_result", **result)
+        if self.obs.enabled:
+            self._emit_breakdown(best)
         return self.assignment_to_strategy(best), info
+
+    def _emit_breakdown(self, assignment: Sequence[int]) -> None:
+        """Per-op cost breakdown of an assignment (the winning strategy's
+        ``search_breakdown`` obs record).  Costs come from the already-
+        warmed cost model (a measured model serves its cache)."""
+        topo = self.machine.topology
+        n_dev = self.machine.num_devices
+        rows = []
+        for op, cands, idx in zip(self.ops, self.candidates, assignment):
+            if isinstance(op, _InputSource):
+                continue
+            pc = cands[idx]
+            rows.append({
+                "op": op.name, "kind": type(op).__name__,
+                "dims": list(pc.dims),
+                "devices": len(set(pc.devices)),
+                "compute_s": float(self.cost_model.op_cost(op, pc)),
+                "collective_s": float(
+                    collective_cost(op, pc, topo)
+                    + dispatch_overhead_cost(op, pc, topo, n_dev))})
+        self.obs.event("search_breakdown", ops=rows,
+                       opt_stream_s=self._opt_stream_s)

@@ -113,6 +113,15 @@ class Strategy(dict):
     #: no scheduler to describe (SURVEY §2.6 PP).
     pipeline = None
 
+    #: optional simulator prediction carried on the artifact (obs
+    #: subsystem): {"best_time_s": s, "dp_time_s": s, "devices": n, ...}
+    #: written by apps/search.py so a consuming ``fit()`` can emit the
+    #: ``sim_drift`` gauge (measured vs simulated step time — the
+    #: calibration signal behind the round-4 transformer_2x4
+    #: falsification) without rebuilding the simulator.  JSON-only, like
+    #: ``pipeline``.
+    predicted = None
+
     # ---------- JSON ----------
 
     def to_json(self) -> str:
@@ -125,6 +134,8 @@ class Strategy(dict):
                 "stages": int(self.pipeline["stages"]),
                 "microbatches": int(self.pipeline["microbatches"]),
                 "tp": int(self.pipeline.get("tp", 1))}
+        if self.predicted:
+            obj["__predicted__"] = dict(self.predicted)
         return json.dumps(obj, indent=2, sort_keys=True)
 
     @classmethod
@@ -136,6 +147,9 @@ class Strategy(dict):
             s.pipeline = {"stages": int(pp["stages"]),
                           "microbatches": int(pp["microbatches"]),
                           "tp": int(pp.get("tp", 1))}
+        pred = obj.pop("__predicted__", None)
+        if pred:
+            s.predicted = dict(pred)
         for name, d in obj.items():
             s[name] = ParallelConfig(tuple(d["dims"]), tuple(d["devices"]))
         return s

@@ -28,6 +28,32 @@ import dataclasses
 from typing import Dict, List, Optional
 
 
+class StepClock:
+    """Host-side per-step wall clock for the obs subsystem (model.fit).
+
+    ``tick()`` appends one ``perf_counter`` delta per step — no device
+    syncs, so the timed loop's async dispatch is unperturbed; under jit
+    donation the host timestamps track device step time after the first
+    couple of iterations (step N+1's dispatch blocks on N's buffers).
+    The deltas are read AFTER the loop, when per-step records are
+    written."""
+
+    def __init__(self):
+        import time as _time
+
+        self._clock = _time.perf_counter
+        self._last = self._clock()
+        self.deltas: List[float] = []
+
+    def reset(self):
+        self._last = self._clock()
+
+    def tick(self) -> None:
+        now = self._clock()
+        self.deltas.append(now - self._last)
+        self._last = now
+
+
 @contextlib.contextmanager
 def trace(logdir: str):
     """XProf/TensorBoard trace of everything executed inside the block
