@@ -14,6 +14,15 @@ computations on the local chip (scripts/cnn.h measure_* parity); default is
 the analytic MXU/HBM roofline.  ``-o x.json`` writes JSON; any other
 extension writes the reference-wire-compatible proto.
 
+``-chains N`` runs N parallel Metropolis chains on native threads with
+deterministic best-state exchange between chunks (chain 0 reproduces the
+single-chain search for a fixed seed).  ``-delta on|off|check`` controls
+the delta re-simulation: ``on`` (default) prices each proposal in
+~O(affected ops), ``off`` pays a full re-simulation per proposal, and
+``check`` cross-checks every delta against a full re-simulation, aborting
+on divergence > 1e-9 (debug mode; the accepted sequence is identical in
+all three for a fixed seed).
+
 Run telemetry (obs subsystem): ``-obs-dir DIR`` appends the structured
 event stream (search_space, per-chunk MCMC trajectory, search_result,
 per-op breakdown, pipeline + hlo_audit records) to
@@ -41,7 +50,7 @@ def parse_args(argv):
         "out": "", "measured": False, "batch_size": 64, "seed": 0,
         "ici_group": None, "cache": "", "audit": None,
         "dtype": "float32", "dcn_calibration": "", "experts": 0,
-        "obs_dir": "", "run_id": "",
+        "obs_dir": "", "run_id": "", "chains": 1, "delta": "on",
     }
     from flexflow_tpu.utils.flags import flag_stream
 
@@ -84,6 +93,17 @@ def parse_args(argv):
             opts["obs_dir"] = val()
         elif a in ("-run-id", "--run-id"):
             opts["run_id"] = val()
+        elif a in ("-chains", "--chains"):
+            # parallel MCMC chains (native threads, deterministic
+            # best-state exchange between chunks)
+            opts["chains"] = int(val())
+        elif a in ("-delta", "--delta"):
+            # delta re-simulation: on (default) | off (full re-simulation
+            # per proposal) | check (delta cross-checked vs full; debug)
+            opts["delta"] = val()
+    if opts["delta"] not in ("on", "off", "check"):
+        raise SystemExit(f"-delta must be on|off|check, got "
+                         f"{opts['delta']!r}")
     return opts
 
 
@@ -135,6 +155,13 @@ def _audit_strategy(strategy, opts, machine, dp_known=None):
         os.unlink(path)
 
 
+def _search_kw(opts):
+    """search() keywords from the -chains / -delta flags."""
+    return {"chains": opts.get("chains", 1),
+            "delta": opts.get("delta", "on") != "off",
+            "delta_check": opts.get("delta", "on") == "check"}
+
+
 def _grounded_accept(opts, machine, model, cost_model, search, strategy,
                      info, log):
     """The executor-grounded accept path: audit the searched plan's
@@ -176,7 +203,8 @@ def _grounded_accept(opts, machine, model, cost_model, search, strategy,
         "subset placement is what defeated the lowering")
     s2 = StrategySearch(model, machine, cost_model=cost_model,
                         placement=False, obs=search.obs)
-    strategy2, info2 = s2.search(iters=opts["iters"], seed=opts["seed"])
+    strategy2, info2 = s2.search(iters=opts["iters"], seed=opts["seed"],
+                                 **_search_kw(opts))
     if info2["speedup_vs_dp"] > 1.05:
         try:
             audit2, ok2 = run_audit(
@@ -247,7 +275,8 @@ def main(argv=None, log=print) -> dict:
 
     meta = {"app": "search", "model": opts["model"],
             "devices": machine.num_devices, "iters": opts["iters"],
-            "measured": opts["measured"], "seed": opts["seed"]}
+            "measured": opts["measured"], "seed": opts["seed"],
+            "chains": opts["chains"], "delta": opts["delta"]}
     if opts["obs_dir"]:
         run_id = opts["run_id"] or _obs.new_run_id()
         olog = _obs.RunLog(
@@ -264,7 +293,8 @@ def main(argv=None, log=print) -> dict:
 
     search = StrategySearch(model, machine, cost_model=cost_model,
                             obs=olog)
-    strategy, info = search.search(iters=opts["iters"], seed=opts["seed"])
+    strategy, info = search.search(iters=opts["iters"], seed=opts["seed"],
+                                   **_search_kw(opts))
     result = {
         "model": opts["model"],
         "devices": machine.num_devices,

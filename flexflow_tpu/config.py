@@ -49,6 +49,12 @@ class FFConfig:
     # time+pid id; set it to join several processes into one stream.
     obs_dir: str = ""
     run_id: str = ""
+    # strategy search (sim/search.py): number of parallel MCMC chains and
+    # the delta re-simulation mode — "on" (default), "off" (every proposal
+    # pays a full re-simulation) or "check" (delta cross-checked against
+    # full, aborting on divergence; debug only)
+    search_chains: int = 1
+    search_delta: str = "on"
 
     strategies: Strategy = dataclasses.field(default_factory=Strategy)
 
@@ -109,6 +115,10 @@ class FFConfig:
                 cfg.obs_dir = val()
             elif a in ("-run-id", "--run-id"):
                 cfg.run_id = val()
+            elif a in ("-chains", "--chains"):
+                cfg.search_chains = int(val())
+            elif a in ("-delta", "--delta"):
+                cfg.search_delta = val()
             elif a == "--ckpt-dir":
                 cfg.ckpt_dir = val()
             elif a == "--ckpt-freq":

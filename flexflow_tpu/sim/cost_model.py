@@ -96,6 +96,12 @@ class AnalyticCostModel:
 
     def __init__(self, perf: Optional[TpuChipPerf] = None):
         self.perf = perf or TpuChipPerf()
+        # an analytic model has no measurement cache, but the search's
+        # obs record reports cost-cache counters for EVERY cost model —
+        # zeroed here so the record schema is uniform (no duck-typing at
+        # the call site)
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     def op_cost(self, op: Op, pc: ParallelConfig) -> float:
         n_parts = pc.num_parts
@@ -171,8 +177,25 @@ class MeasuredCostModel:
             return
         merged = dict(self._foreign)
         merged.update(self._cache)
-        with open(self.cache_path, "w") as f:
-            json.dump(merged, f, indent=1, sort_keys=True)
+        # atomic replace: a crash mid-write must not corrupt the cache
+        # every future search loads (temp file in the same directory so
+        # os.replace stays a same-filesystem rename)
+        import tempfile
+
+        dest = os.path.abspath(self.cache_path)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest),
+                                   prefix=os.path.basename(dest) + ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(merged, f, indent=1, sort_keys=True)
+            os.replace(tmp, dest)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         self._dirty = 0
 
     def flush(self):

@@ -49,8 +49,85 @@ def _load():
                                    ctypes.c_int64, ctypes.c_double,
                                    ctypes.c_uint64,
                                    ctypes.POINTER(ctypes.c_int64)]
+    lib.ffsim_set_delta.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ffsim_set_crosscheck.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ffsim_state_create.restype = ctypes.c_void_p
+    lib.ffsim_state_create.argtypes = [ctypes.c_void_p]
+    lib.ffsim_state_destroy.argtypes = [ctypes.c_void_p]
+    lib.ffsim_state_init.restype = ctypes.c_double
+    lib.ffsim_state_init.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_int32)]
+    lib.ffsim_state_propose.restype = ctypes.c_double
+    lib.ffsim_state_propose.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_int32, ctypes.c_int32]
+    lib.ffsim_state_commit.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.ffsim_mcmc_chains.restype = ctypes.c_double
+    lib.ffsim_mcmc_chains.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int32),
+                                      ctypes.c_int64, ctypes.c_double,
+                                      ctypes.c_uint64, ctypes.c_int32,
+                                      ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_int64)]
+    lib.ffsim_mcmc_chains_run.restype = ctypes.c_double
+    lib.ffsim_mcmc_chains_run.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_int32),
+                                          ctypes.POINTER(ctypes.c_int32),
+                                          ctypes.POINTER(ctypes.c_double),
+                                          ctypes.c_int64, ctypes.c_double,
+                                          ctypes.c_uint64, ctypes.c_int32,
+                                          ctypes.POINTER(ctypes.c_int64)]
     _lib = lib
     return lib
+
+
+def _i32(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _f64(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _i64(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+class DeltaState:
+    """Caller-driven delta re-simulation: a cached schedule for one
+    assignment plus propose/commit of single-op config changes, each
+    proposal costing ~O(affected ops) instead of a full re-simulation.
+    Results are bit-identical to ``NativeSimulator.simulate`` (the native
+    cross-check mode enforces this)."""
+
+    def __init__(self, sim: "NativeSimulator"):
+        self._sim = sim
+        self._handle = _load().ffsim_state_create(sim._handle)
+
+    def init(self, assignment: Sequence[int]) -> float:
+        """Full simulation that (re)anchors the cached schedule; returns
+        the assignment's simulated raw time."""
+        a = np.ascontiguousarray(assignment, dtype=np.int32)
+        assert len(a) == self._sim.n_ops
+        return _load().ffsim_state_init(self._sim._handle, self._handle,
+                                        _i32(a))
+
+    def propose(self, op: int, cfg: int) -> float:
+        """Simulated raw time of changing ``op`` to config ``cfg`` (delta
+        re-propagation; the cached schedule is untouched until commit)."""
+        return _load().ffsim_state_propose(self._sim._handle, self._handle,
+                                           op, cfg)
+
+    def commit(self) -> None:
+        """Adopt the last propose() into the cached schedule."""
+        _load().ffsim_state_commit(self._sim._handle, self._handle)
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            try:
+                _load().ffsim_state_destroy(self._handle)
+            except Exception:
+                pass
+            self._handle = None
 
 
 class NativeSimulator:
@@ -94,22 +171,78 @@ class NativeSimulator:
         """Advance a caller-owned MCMC chain by ``iters`` proposals (the
         chunk-resumable path behind the obs trajectory records).  Pass
         ``cur_t < 0`` on the first chunk to have the native side compute
-        it.  Returns (cur, best, cur_t, best_t, accepted, proposed)."""
+        it.  Returns (cur, best, cur_t, best_t, accepted, proposed,
+        delta_evals, full_evals)."""
         lib = _load()
         c = np.ascontiguousarray(cur, dtype=np.int32).copy()
         b = np.ascontiguousarray(best, dtype=np.int32).copy()
         assert len(c) == self.n_ops and len(b) == self.n_ops
         times = np.array([cur_t, best_t], dtype=np.float64)
-        stats = np.zeros(2, dtype=np.int64)
-        lib.ffsim_mcmc_run(
-            self._handle,
-            c.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            times.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            iters, beta, seed,
-            stats.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        stats = np.zeros(4, dtype=np.int64)
+        lib.ffsim_mcmc_run(self._handle, _i32(c), _i32(b), _f64(times),
+                           iters, beta, seed, _i64(stats))
         return (c.tolist(), b.tolist(), float(times[0]), float(times[1]),
-                int(stats[0]), int(stats[1]))
+                int(stats[0]), int(stats[1]), int(stats[2]), int(stats[3]))
+
+    def set_delta(self, on: bool) -> None:
+        """Delta re-simulation inside the native MCMC loops (default on;
+        off = every proposal pays a full re-simulation)."""
+        _load().ffsim_set_delta(self._handle, 1 if on else 0)
+
+    def set_crosscheck(self, on: bool) -> None:
+        """Debug mode: every delta evaluation is cross-checked against a
+        full re-simulation; divergence > 1e-9 aborts the process."""
+        _load().ffsim_set_crosscheck(self._handle, 1 if on else 0)
+
+    def delta_state(self) -> DeltaState:
+        return DeltaState(self)
+
+    def mcmc_chains(self, assignment: Sequence[int], iters: int = 250_000,
+                    beta: float = 5e3, seed: int = 0, chains: int = 4,
+                    exchange_every: int = 0):
+        """N independent chains on native threads with deterministic
+        best-state exchange every ``exchange_every`` proposals (0 = no
+        exchange).  Chain 0 uses ``seed`` verbatim, so ``chains=1``
+        reproduces :meth:`mcmc` exactly.  Returns (best_assignment,
+        best_time, per_chain_stats) where each stats entry is
+        {accepted, proposed, delta_evals, full_evals}."""
+        lib = _load()
+        a = np.ascontiguousarray(assignment, dtype=np.int32).copy()
+        assert len(a) == self.n_ops
+        stats = np.zeros(max(1, chains) * 4, dtype=np.int64)
+        t = lib.ffsim_mcmc_chains(self._handle, _i32(a), iters, beta, seed,
+                                  chains, exchange_every, _i64(stats))
+        per_chain = [
+            {"accepted": int(stats[i * 4]), "proposed": int(stats[i * 4 + 1]),
+             "delta_evals": int(stats[i * 4 + 2]),
+             "full_evals": int(stats[i * 4 + 3])}
+            for i in range(max(1, chains))]
+        return a.tolist(), t, per_chain
+
+    def mcmc_chains_chunk(self, curs, bests, times, iters: int,
+                          beta: float = 5e3, seed: int = 0):
+        """One chunk of every chain, concurrently (no internal exchange —
+        the caller exchanges best states between chunks and emits the
+        per-chain obs records).  ``curs``/``bests`` are per-chain
+        assignment lists, ``times`` per-chain [cur_t, best_t] (cur_t < 0
+        on the first chunk).  Returns (curs, bests, times, per_chain_stats)
+        with stats entries as in :meth:`mcmc_chains`."""
+        lib = _load()
+        chains = len(curs)
+        c = np.ascontiguousarray(curs, dtype=np.int32).copy()
+        b = np.ascontiguousarray(bests, dtype=np.int32).copy()
+        assert c.shape == (chains, self.n_ops) == b.shape
+        t = np.ascontiguousarray(times, dtype=np.float64).copy()
+        assert t.shape == (chains, 2)
+        stats = np.zeros(chains * 4, dtype=np.int64)
+        lib.ffsim_mcmc_chains_run(self._handle, _i32(c), _i32(b), _f64(t),
+                                  iters, beta, seed, chains, _i64(stats))
+        per_chain = [
+            {"accepted": int(stats[i * 4]), "proposed": int(stats[i * 4 + 1]),
+             "delta_evals": int(stats[i * 4 + 2]),
+             "full_evals": int(stats[i * 4 + 3])}
+            for i in range(chains)]
+        return (c.tolist(), b.tolist(), t.tolist(), per_chain)
 
     def __del__(self):
         if getattr(self, "_handle", None):

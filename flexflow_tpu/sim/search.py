@@ -933,52 +933,92 @@ class StrategySearch:
         return out
 
     def search(self, iters: int = 250_000, beta: float = 5e3,
-               seed: int = 0, chunks: int = 25):
+               seed: int = 0, chunks: int = 25, chains: int = 1,
+               delta: bool = True, delta_check: bool = False):
         """MCMC from the DP start point (reference: scripts/simulator.cc
-        :1427-1471).  The chain runs as up to ``chunks`` chain-continuing
-        native calls (ffsim_mcmc_run) so the trajectory is observable:
-        each chunk emits a ``search_chunk`` obs record (best-cost curve,
-        acceptance rate, proposals/sec) and the run closes with
-        ``search_result`` + ``search_breakdown`` records.  Per-proposal
-        semantics match the single-call native path (chunking only
-        re-seeds per chunk).  Returns (strategy, info); ``info["trace"]``
-        carries the per-chunk trajectory for programmatic callers."""
+        :1427-1471).  ``chains`` independent Metropolis chains advance
+        concurrently on native threads (per-chain RNG derived from
+        ``seed``; chain 0 IS the legacy single chain, so ``chains=1``
+        reproduces the old trajectory exactly), in up to ``chunks``
+        chain-continuing native calls (ffsim_mcmc_chains_run) so the
+        trajectory is observable: each chunk emits one ``search_chunk``
+        obs record PER CHAIN (chain id, best-cost curve, acceptance rate,
+        proposals/sec, delta-hit rate) and the run closes with
+        ``search_result`` + ``search_breakdown`` records.  Between chunks
+        the chains exchange best states deterministically (every chain
+        whose current cost is worse than the global best adopts it).
+        ``delta`` gates the native delta re-simulation (off = every
+        proposal pays a full re-simulation); ``delta_check`` additionally
+        cross-checks every delta against a full re-simulation and aborts
+        on divergence (debug mode — per-proposal acceptance semantics are
+        identical either way).  Returns (strategy, info);
+        ``info["trace"]`` carries the per-(chunk, chain) trajectory for
+        programmatic callers."""
         import time as _time
 
         dp = self.dp_assignment()
         dp_time = self.simulate(dp)
+        chains = max(1, int(chains))
+        self.sim.set_delta(delta)
+        self.sim.set_crosscheck(delta_check)
         chunks = max(1, min(int(chunks), max(iters, 1)))
-        cur, best = list(dp), list(dp)
-        cur_t = best_t = -1.0  # native computes the raw makespan lazily
+        curs = [list(dp) for _ in range(chains)]
+        bests = [list(dp) for _ in range(chains)]
+        times = [[-1.0, -1.0] for _ in range(chains)]
         trace = []
-        tot_acc = tot_prop = done = 0
+        tot_acc = tot_prop = tot_delta = tot_full = done = 0
+        tot_wall = 0.0
         for ci in range(chunks):
             it_n = iters // chunks + (1 if ci < iters % chunks else 0)
             if it_n <= 0:
                 continue
             t0 = _time.perf_counter()
-            cur, best, cur_t, best_t, acc, prop = self.sim.mcmc_chunk(
-                cur, best, cur_t, best_t, it_n, beta=beta,
+            curs, bests, times, stats = self.sim.mcmc_chains_chunk(
+                curs, bests, times, it_n, beta=beta,
                 seed=seed * 1_000_003 + ci)
             wall = _time.perf_counter() - t0
+            tot_wall += wall
             done += it_n
-            tot_acc += acc
-            tot_prop += prop
-            rec = {
-                "iters_done": done,
-                "best_time_s": best_t + self._opt_stream_s,
-                "cur_time_s": cur_t + self._opt_stream_s,
-                "accepted": acc, "proposed": prop,
-                "accept_rate": acc / prop if prop else 0.0,
-                "proposals_per_sec": prop / wall if wall > 0 else 0.0,
-                "wall_s": wall,
-            }
-            trace.append(rec)
-            self.obs.event("search_chunk", **rec)
+            for chain_i in range(chains):
+                st = stats[chain_i]
+                tot_acc += st["accepted"]
+                tot_prop += st["proposed"]
+                tot_delta += st["delta_evals"]
+                tot_full += st["full_evals"]
+                evals = st["delta_evals"] + st["full_evals"]
+                rec = {
+                    "chain": chain_i,
+                    "iters_done": done,
+                    "best_time_s": times[chain_i][1] + self._opt_stream_s,
+                    "cur_time_s": times[chain_i][0] + self._opt_stream_s,
+                    "accepted": st["accepted"], "proposed": st["proposed"],
+                    "accept_rate": st["accepted"] / st["proposed"]
+                    if st["proposed"] else 0.0,
+                    "proposals_per_sec": st["proposed"] / wall
+                    if wall > 0 else 0.0,
+                    "delta_hit_rate": st["delta_evals"] / evals
+                    if evals else 0.0,
+                    "wall_s": wall,
+                }
+                trace.append(rec)
+                self.obs.event("search_chunk", **rec)
+            if chains > 1:
+                # deterministic elitist exchange (mirrors the native
+                # one-shot ffsim_mcmc_chains: ties break to the lowest
+                # chain id, so a fixed seed reproduces the run)
+                gb = min(range(chains), key=lambda i: (times[i][1], i))
+                for i in range(chains):
+                    if i != gb and times[gb][1] < times[i][0]:
+                        curs[i] = list(bests[gb])
+                        times[i][0] = times[gb][1]
         if done == 0:  # iters <= 0: the DP start point is the answer
             best, best_t = list(dp), self.sim.simulate(dp)
+        else:
+            gb = min(range(chains), key=lambda i: (times[i][1], i))
+            best, best_t = bests[gb], times[gb][1]
         best_time = best_t + self._opt_stream_s  # the optimizer stream is
-        # assignment-invariant; the native chain ranks raw makespans
+        # assignment-invariant; the native chains rank raw makespans
+        evals = tot_delta + tot_full
         info = {
             "dp_time": dp_time,
             "best_time": best_time,
@@ -986,16 +1026,20 @@ class StrategySearch:
             "assignment": best,
             "trace": trace,
             "accept_rate": tot_acc / tot_prop if tot_prop else 0.0,
+            "chains": chains,
+            "delta": delta,
+            "delta_hit_rate": tot_delta / evals if evals else 0.0,
+            "proposals_per_sec": tot_prop / tot_wall if tot_wall > 0 else 0.0,
         }
         result = {"dp_time_s": dp_time, "best_time_s": best_time,
                   "speedup_vs_dp": info["speedup_vs_dp"], "iters": done,
                   "accepted": tot_acc, "proposed": tot_prop,
                   "accept_rate": info["accept_rate"], "seed": seed,
-                  "beta": beta}
-        if hasattr(self.cost_model, "cache_hits"):
-            result["cost_cache"] = {
-                "hits": self.cost_model.cache_hits,
-                "misses": self.cost_model.cache_misses}
+                  "beta": beta, "chains": chains, "delta": delta,
+                  "delta_hit_rate": info["delta_hit_rate"],
+                  "proposals_per_sec": info["proposals_per_sec"],
+                  "cost_cache": {"hits": self.cost_model.cache_hits,
+                                 "misses": self.cost_model.cache_misses}}
         self.obs.event("search_result", **result)
         if self.obs.enabled:
             self._emit_breakdown(best)

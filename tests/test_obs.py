@@ -6,6 +6,8 @@ import json
 import os
 import threading
 
+import pytest
+
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.data import synthetic_batches
 from flexflow_tpu.model import FFModel
@@ -212,6 +214,7 @@ def _searcher(machine8, tmp_path, run_id="search"):
     return StrategySearch(ff, machine8, obs=ol), ol
 
 
+@pytest.mark.native
 def test_search_trace_monotone_best_cost(tmp_path, machine8):
     ss, ol = _searcher(machine8, tmp_path)
     strategy, info = ss.search(iters=2000, seed=1)
@@ -244,6 +247,7 @@ def test_search_trace_monotone_best_cost(tmp_path, machine8):
     assert all(r["compute_s"] > 0 for r in bd["ops"])
 
 
+@pytest.mark.native
 def test_search_chunked_matches_info_and_strategy(tmp_path, machine8):
     # the chunked chain still returns an executable strategy whose
     # simulated cost equals info["best_time"]
@@ -255,15 +259,49 @@ def test_search_chunked_matches_info_and_strategy(tmp_path, machine8):
     assert info["speedup_vs_dp"] >= 1.0 - 1e-9
 
 
+@pytest.mark.native
 def test_assignment_for_rejects_foreign_pc(machine8, tmp_path):
-    import pytest
-
     ss, ol = _searcher(machine8, tmp_path, run_id="s3")
     ol.close()
     foreign = Strategy()
     foreign["conv1"] = ParallelConfig((1, 1, 1, 3), (0, 1, 2))
     with pytest.raises(KeyError):
         ss.assignment_for(foreign)
+
+
+@pytest.mark.native
+def test_search_multichain_per_chain_monotone(tmp_path, machine8):
+    """chains=2: one search_chunk record per chain per chunk, each chain's
+    best-cost trajectory non-increasing, delta-hit rate reported, and the
+    final best equals the best chain's last best."""
+    ss, ol = _searcher(machine8, tmp_path, run_id="mc")
+    strategy, info = ss.search(iters=1200, seed=3, chains=2, chunks=4)
+    ol.close()
+    evs = list(read_events(ol.path))
+    chunks = [e for e in evs if e["kind"] == "search_chunk"]
+    by_chain = {}
+    for c in chunks:
+        by_chain.setdefault(c["chain"], []).append(c)
+    assert set(by_chain) == {0, 1}
+    for cid, recs in by_chain.items():
+        curve = [r["best_time_s"] for r in recs]
+        assert all(a >= b - 1e-12 for a, b in zip(curve, curve[1:])), \
+            f"chain {cid} best-cost curve must be non-increasing: {curve}"
+        for r in recs:
+            assert 0.0 <= r["delta_hit_rate"] <= 1.0
+            assert r["proposals_per_sec"] >= 0.0
+    assert info["chains"] == 2
+    assert info["best_time"] == min(
+        recs[-1]["best_time_s"] for recs in by_chain.values())
+    (result,) = [e for e in evs if e["kind"] == "search_result"]
+    assert result["chains"] == 2
+    assert result["cost_cache"] == {"hits": 0, "misses": 0}  # analytic
+    # deterministic across runs: same seed, same chains -> same plan
+    ss2, ol2 = _searcher(machine8, tmp_path, run_id="mc2")
+    _, info2 = ss2.search(iters=1200, seed=3, chains=2, chunks=4)
+    ol2.close()
+    assert info2["assignment"] == info["assignment"]
+    assert info2["best_time"] == info["best_time"]
 
 
 # ---------------------------------------------------------------------------
@@ -320,6 +358,13 @@ def test_obs_flags_parsed():
 
     sopts = s_args(["alexnet", "-obs-dir", "/tmp/s", "-run-id", "sr"])
     assert sopts["obs_dir"] == "/tmp/s" and sopts["run_id"] == "sr"
+    # -chains / -delta ride both parsers (PR 2)
+    sopts = s_args(["alexnet", "-chains", "4", "-delta", "check"])
+    assert sopts["chains"] == 4 and sopts["delta"] == "check"
+    cfg = FFConfig.from_args(["-chains", "8", "-delta", "off"])
+    assert cfg.search_chains == 8 and cfg.search_delta == "off"
+    with pytest.raises(SystemExit):
+        s_args(["alexnet", "-delta", "sometimes"])
 
 
 def test_strategy_predicted_roundtrip(tmp_path):
@@ -336,6 +381,7 @@ def test_strategy_predicted_roundtrip(tmp_path):
     assert s3.predicted is None
 
 
+@pytest.mark.native
 def test_report_cli_renders_fit_and_search(tmp_path, machine8, capsys):
     cfg = _cfg(tmp_path, run_id="rep")
     ff = _small_model(machine8, cfg)

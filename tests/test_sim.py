@@ -58,6 +58,7 @@ def test_geometry_covers_output(machine8):
     assert vol == conv.output.size()
 
 
+@pytest.mark.native
 def test_simulator_analytic_schedule():
     """Hand-checkable chain: two ops, DP over 2 devices, no comm between
     aligned shards -> makespan == sum of per-shard costs; forcing a
@@ -99,6 +100,7 @@ def test_simulator_analytic_schedule():
     assert abs(t_swapped - 3.32) < 1e-9
 
 
+@pytest.mark.native
 def test_mcmc_finds_better_than_dp(machine8):
     """On a model with a big FC layer and generous intra bandwidth penalty,
     search must find something at least as good as pure DP."""
@@ -135,6 +137,7 @@ def test_mcmc_finds_better_than_dp(machine8):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.native
 def test_strategy_round_trip_through_file(tmp_path, machine8):
     ff = tiny_model(machine8)
     search = StrategySearch(ff, machine8)
@@ -147,6 +150,7 @@ def test_strategy_round_trip_through_file(tmp_path, machine8):
     assert loaded == strategy
 
 
+@pytest.mark.native
 def test_nmt_search_builds(machine8):
     """Search over the RNN model's op set (geometry for slice/embed/lstm/
     rnn-linear/softmaxDP paths)."""
@@ -159,6 +163,116 @@ def test_nmt_search_builds(machine8):
     strategy, info = search.search(iters=1000, seed=2)
     assert info["best_time"] > 0
     assert "lstm0_0" in strategy
+
+
+# ---------------------------------------------------------------------------
+# delta re-simulation + multi-chain MCMC (PR 2): per-proposal cost is
+# O(affected ops); correctness is guarded by a randomized delta-vs-full
+# equivalence property, determinism of the threaded multi-chain search,
+# and equivalence of the delta / full / cross-checked MCMC paths.
+
+
+def _random_native_sim(rng, n_devices=4, n_ops=8):
+    """A randomized task graph straight at the serialized-buffer level:
+    random DAG wiring, config/point counts, devices, rectangles and cost
+    tables — deliberately unconstrained by op geometry so the delta walk
+    sees adversarial overlap/dependency patterns."""
+    ints = [n_devices, 2, n_ops]
+    compute, replicas, colls, pbytes = [], [], [], []
+    n_cfgs = []
+    for o in range(n_ops):
+        n_inputs = 0 if o == 0 else int(rng.integers(0, min(o, 2) + 1))
+        producers = [int(rng.integers(-1, o)) for _ in range(n_inputs)]
+        ints.append(n_inputs)
+        ints.extend(producers)
+        n_cfg = int(rng.integers(1, 4))
+        ints.append(n_cfg)
+        for _c in range(n_cfg):
+            n_pts = int(rng.integers(1, 5))
+            ints.append(n_pts)
+            for _p in range(n_pts):
+                ints.append(int(rng.integers(0, n_devices)))
+                for _r in range(1 + n_inputs):  # out rect + input rects
+                    for _d in range(2):
+                        lo = int(rng.integers(0, 12))
+                        ints.extend((lo, lo + int(rng.integers(1, 8))))
+                    ints.extend((0, 1, 0, 1))
+            compute.append(float(rng.uniform(1e-4, 1e-2)))
+            replicas.append(float(rng.choice([1.0, 2.0, 4.0])))
+            colls.append(float(rng.uniform(0, 1e-3)))
+        pbytes.append(float(rng.choice([0.0, 1e6])))
+        n_cfgs.append(n_cfg)
+    dbls = [1e9, 1e8, float(rng.uniform(0, 1e-5))] \
+        + pbytes + compute + replicas + colls
+    return NativeSimulator(ints, dbls, n_ops), n_cfgs
+
+
+@pytest.mark.native
+def test_delta_matches_full_randomized():
+    """Property: over randomized graphs, assignments and single-op
+    proposal sequences (committed or not), delta re-simulation matches a
+    from-scratch full simulate() to <= 1e-9 (it is bit-identical by
+    construction; the tolerance is the contract)."""
+    rng = np.random.default_rng(1234)
+    for _trial in range(25):
+        sim, n_cfgs = _random_native_sim(
+            rng, n_devices=int(rng.integers(2, 6)),
+            n_ops=int(rng.integers(3, 10)))
+        cur = [int(rng.integers(0, n_cfgs[o])) for o in range(sim.n_ops)]
+        st = sim.delta_state()
+        assert st.init(cur) == pytest.approx(sim.simulate(cur), abs=1e-12)
+        for _k in range(40):
+            o = int(rng.integers(0, sim.n_ops))
+            c = int(rng.integers(0, n_cfgs[o]))
+            t_delta = st.propose(o, c)
+            trial_assign = list(cur)
+            trial_assign[o] = c
+            t_full = sim.simulate(trial_assign)
+            assert abs(t_delta - t_full) <= 1e-9, \
+                (o, c, t_delta, t_full)
+            if rng.random() < 0.5:  # exercise both commit and discard
+                st.commit()
+                cur = trial_assign
+
+
+@pytest.mark.native
+def test_mcmc_chains_deterministic():
+    """ffsim_mcmc_chains with a fixed base seed reproduces identical best
+    assignments and costs across runs (barrier-synchronized deterministic
+    exchange, per-chain RNG derived from the base seed)."""
+    sim, n_cfgs = _random_native_sim(np.random.default_rng(7),
+                                     n_devices=4, n_ops=8)
+    start = [0] * sim.n_ops
+    b1, t1, s1 = sim.mcmc_chains(start, iters=2000, seed=11, chains=3,
+                                 exchange_every=400)
+    b2, t2, s2 = sim.mcmc_chains(start, iters=2000, seed=11, chains=3,
+                                 exchange_every=400)
+    assert b1 == b2 and t1 == t2 and s1 == s2
+    assert t1 <= sim.simulate(start) + 1e-12
+    for st in s1:
+        assert 0 <= st["accepted"] <= st["proposed"]
+
+
+@pytest.mark.native
+def test_mcmc_delta_full_crosscheck_equivalent():
+    """Same seed => same accepted sequence (hence identical best) across
+    the delta path, the full-simulate path, and the delta path with the
+    native cross-check mode on; and chains=1 of the multi-chain entry
+    point reproduces the single-chain one."""
+    sim, n_cfgs = _random_native_sim(np.random.default_rng(3),
+                                     n_devices=4, n_ops=8)
+    start = [0] * sim.n_ops
+    b_delta, t_delta = sim.mcmc(start, iters=2000, seed=5)
+    sim.set_crosscheck(True)  # every delta verified vs full (abort on
+    b_check, t_check = sim.mcmc(start, iters=2000, seed=5)  # divergence)
+    sim.set_crosscheck(False)
+    sim.set_delta(False)
+    b_full, t_full = sim.mcmc(start, iters=2000, seed=5)
+    sim.set_delta(True)
+    assert b_delta == b_check == b_full
+    assert t_delta == t_check == t_full
+    b_c1, t_c1, _ = sim.mcmc_chains(start, iters=2000, seed=5, chains=1)
+    assert b_c1 == b_delta and t_c1 == t_delta
 
 
 # ---------------------------------------------------------------------------
@@ -228,6 +342,7 @@ def test_kind_anchor_scales_unmeasurable_candidates():
     assert f"estimate|{m._key(b, b.pc)}" in m._foreign
 
 
+@pytest.mark.native
 def test_fused_head_ops_get_no_subset_candidates(machine8):
     """RnnLinear heads feeding SoftmaxDP keep only full-machine
     candidates: subset placement would de-fuse the vocab head into the
