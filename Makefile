@@ -1,0 +1,21 @@
+# Repo-level entry points; the native build lives in flexflow_tpu/native.
+PYTHON ?= python
+
+.PHONY: native check trace-smoke test
+
+# build the native simulator + dataloader libraries
+native:
+	$(MAKE) -C flexflow_tpu/native
+
+# native build + ctypes smoke of ffsim_simulate
+check:
+	$(MAKE) -C flexflow_tpu/native check
+
+# build libffsim.so and assert ffsim_simulate_trace produces a parseable
+# Chrome/Perfetto trace for a toy graph (obs/trace.py --smoke)
+trace-smoke:
+	$(MAKE) -C flexflow_tpu/native trace-smoke
+
+# the tier-1 test selection (CPU, 8-device virtual mesh)
+test:
+	$(PYTHON) -m pytest tests/ -q -m 'not slow'

@@ -151,6 +151,16 @@ def _bench_record():
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    # the benched strategy's simulated timeline, when the search exported
+    # one next to the artifact (apps/search.py -trace writes
+    # <stem>.trace.json): its path rides the metric line so the harness
+    # can hand sim + bench to `apps/report.py trace` without guessing
+    if strategy_file:
+        stem = os.path.splitext(strategy_file)[0]
+        for cand in (stem + ".trace.json", strategy_file + ".trace.json"):
+            if os.path.exists(cand):
+                out["trace_path"] = cand
+                break
     # Side report (VERDICT r1 #5): the searched strategy this bench would
     # exercise on a multi-chip machine, with its simulated speedup from the
     # committed search artifacts (examples/strategies/summary.json).

@@ -16,6 +16,24 @@ tests/test_calibration.py asserts the committed measured-model ratios stay
 within +-30%.  Run on the TPU host:
 
     python -m flexflow_tpu.apps.calibrate -o examples/strategies/calibration.json
+
+``--from-obs DIR`` is the drift-driven recalibration path (no probe run,
+no chip access needed beyond the training that already happened): it
+consumes the obs records real runs accumulated — measured per-op
+``op_time`` records (fit's sampled op-timing mode), the simulated per-op
+times of the strategies those runs trained under (``sim_trace`` /
+``search_breakdown``), and the step-level ``sim_drift`` gauges — and
+refits the two knob families the simulator already exposes:
+
+  * per-kind anchor ratios (measured/simulated per op kind, median) —
+    the ``kind_anchors`` seed ``MeasuredCostModel(anchors_path=...)``
+    loads, so unmeasurable candidates rank on the observed scale;
+  * collective constants: the step-time residual the anchored compute
+    does not explain is attributed to communication and folded into
+    ``dcn_bandwidth``/``dcn_latency`` — the exact keys
+    ``Topology.from_calibration`` reads (clamped to 10x either way).
+
+    python -m flexflow_tpu.apps.calibrate --from-obs runs/ -o recal.json
 """
 
 from __future__ import annotations
@@ -122,15 +140,122 @@ def calibrate(out: str = "", log=print) -> dict:
     return payload
 
 
+def _median(values):
+    values = sorted(values)
+    return values[len(values) // 2] if values else None
+
+
+def calibrate_from_obs(obs_dir: str, out: str = "", log=print) -> dict:
+    """Refit cost-model knobs from accumulated obs records (the
+    drift-driven recalibration loop — ROADMAP item, closed here).  Reads
+    every ``*.jsonl`` stream (rotated parts included) under ``obs_dir``;
+    see the module docstring for what is fitted.  The artifact is dual-
+    consumable: ``MeasuredCostModel(anchors_path=...)`` reads
+    ``kind_anchors``, ``Topology.from_calibration`` reads
+    ``dcn_bandwidth``/``dcn_latency``."""
+    import re
+
+    from flexflow_tpu.machine import Topology
+    from flexflow_tpu.obs import read_events
+    from flexflow_tpu.obs.trace import real_op_seconds, sim_op_seconds
+
+    events = []
+    names = sorted(fn for fn in os.listdir(obs_dir)
+                   if fn.endswith(".jsonl")
+                   or re.search(r"\.jsonl\.\d+$", fn))
+    for fn in names:
+        events.extend(read_events(os.path.join(obs_dir, fn)))
+    sim_ops = sim_op_seconds(events)
+    real_ops = real_op_seconds(events)
+    drifts = [e for e in events if e.get("kind") == "sim_drift"]
+    # per-kind anchors: measured / simulated-compute, median per kind.
+    # The compute part is the comparable quantity — the isolated op_time
+    # harness cannot see in-op collectives, so anchoring against
+    # compute_s + collective_s would fold comm error into compute knobs.
+    by_kind = {}
+    joined = 0
+    for op in set(sim_ops) & set(real_ops):
+        kind = sim_ops[op].get("op_kind") or real_ops[op].get("op_kind")
+        base = sim_ops[op].get("compute_s", sim_ops[op]["seconds"])
+        if not real_ops[op].get("measured", True):
+            continue  # analytic stand-in: a real/analytic anchor of
+            #           exactly 1.0 would be circular, not informative
+        if not kind or not base or base <= 0:
+            continue
+        joined += 1
+        by_kind.setdefault(str(kind), []).append(
+            real_ops[op]["seconds"] / base)
+    anchors = {k: round(_median(v), 4) for k, v in sorted(by_kind.items())}
+    # collective constants: the measured step time minus the ANCHORED
+    # compute (and the assignment-invariant optimizer stream) is the
+    # communication budget the run actually paid; its ratio to the
+    # simulated collective seconds rescales the DCN constants.  Clamped —
+    # a residual outside 10x means the attribution itself is suspect.
+    comm_scale = None
+    breakdowns = [e for e in events if e.get("kind") == "search_breakdown"]
+    measured_step = _median([float(d["measured_s"]) for d in drifts
+                             if d.get("measured_s")])
+    if breakdowns and measured_step:
+        bd = breakdowns[-1]
+        anchored_compute = sum(
+            float(r.get("compute_s", 0.0))
+            * anchors.get(str(r.get("kind")), 1.0)
+            for r in bd.get("ops", []))
+        sim_comm = sum(float(r.get("collective_s", 0.0))
+                       for r in bd.get("ops", []))
+        opt_s = float(bd.get("opt_stream_s", 0.0))
+        residual = measured_step - anchored_compute - opt_s
+        if sim_comm > 0 and residual > 0:
+            comm_scale = min(max(residual / sim_comm, 0.1), 10.0)
+    base_topo = Topology()
+    payload = {
+        "source": "obs",
+        "obs_dir": os.path.abspath(obs_dir),
+        "streams": len(names),
+        "records": len(events),
+        "joined_ops": joined,
+        "sim_drift": {"n": len(drifts),
+                      "median_ratio": _median(
+                          [float(d["value"]) for d in drifts
+                           if d.get("value")])},
+        "kind_anchors": anchors,
+        "collective_scale": round(comm_scale, 4) if comm_scale else None,
+        "dcn_bandwidth": base_topo.dcn_bandwidth / (comm_scale or 1.0),
+        "dcn_latency": base_topo.dcn_latency * (comm_scale or 1.0),
+    }
+    for k, v in anchors.items():
+        log(f"anchor {k}: x{v} (n={len(by_kind[k])})")
+    if comm_scale:
+        log(f"collective residual scale: x{comm_scale:.3f} -> "
+            f"dcn_bandwidth {payload['dcn_bandwidth']:.3e} B/s")
+    elif drifts:
+        log("collective constants unchanged (no positive residual or no "
+            "search_breakdown in the streams)")
+    if not anchors and not drifts:
+        log("warning: no op_time/sim_drift records found — run fit() "
+            "with -obs-dir and --op-time-every N first")
+    if out:
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+        log(f"written to {out}")
+    return payload
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     out = ""
+    from_obs = ""
     from flexflow_tpu.utils.flags import flag_stream
 
     for a, val in flag_stream(argv):
         if a in ("-o", "--out"):
             out = val()
-    calibrate(out)
+        elif a == "--from-obs":
+            from_obs = val()
+    if from_obs:
+        calibrate_from_obs(from_obs, out)
+    else:
+        calibrate(out)
 
 
 if __name__ == "__main__":

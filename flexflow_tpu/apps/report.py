@@ -1,34 +1,173 @@
 """Run-telemetry report CLI — the reader for the obs record schema.
 
-    python -m flexflow_tpu.apps.report <run.jsonl> [more.jsonl ...]
+    python -m flexflow_tpu.apps.report <run.jsonl> [more.jsonl ...] [--json]
+    python -m flexflow_tpu.apps.report trace <run.jsonl|x.trace.json ...> \\
+        [-o DIR] [--json]
 
-Renders a run's JSONL event stream (FFConfig.obs_dir / RunLog output, a
-search-trace artifact, or a bench log) into the summary tables humans read
-today: training step/loss/throughput, search best-cost trajectory with
-acceptance stats and the winning strategy's per-op cost breakdown, audit
-and bench records.  Several files render as one merged stream (e.g. a fit
-log plus the search trace that produced its strategy).
+Default mode renders a run's JSONL event stream (FFConfig.obs_dir /
+RunLog output, a search-trace artifact, or a bench log) into the summary
+tables humans read today: training step/loss/throughput, search best-cost
+trajectory with acceptance stats and the winning strategy's per-op cost
+breakdown, audit and bench records.  Several files render as one merged
+stream (e.g. a fit log plus the search trace that produced its strategy);
+rotated streams (``run.jsonl.1``, ...) are walked automatically.
+``--json`` emits the same summary as ONE machine-readable JSON object on
+stdout instead of prose, so CI and bench tooling consume fields.
+
+The ``trace`` subcommand is the drift-attribution pass: it joins
+simulated per-op times (``sim_trace`` records from ``apps/search.py
+-trace``, falling back to ``search_breakdown``; Chrome ``*.trace.json``
+files merge their lanes in) against measured ``op_time`` records (a
+``fit()`` run with ``--op-time-every N``), ranks ops by absolute drift
+contribution, and writes both ``<DIR>/drift_attribution.json`` and a
+merged ``<DIR>/merged.trace.json`` with sim lanes next to real lanes —
+loadable in ui.perfetto.dev.  ``apps/calibrate.py --from-obs`` consumes
+the same records to refit the cost model.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import sys
+
+
+def _read_paths(paths, log):
+    """Events of every given stream: JSONL runs (rotated parts walked via
+    run_files) merged with the events of Chrome trace JSON files.
+    Returns (obs_events, chrome_events)."""
+    from flexflow_tpu.obs import read_events, run_files
+
+    obs_events, chrome_events = [], []
+    for p in paths:
+        if p.endswith(".json"):
+            try:
+                from flexflow_tpu.obs.trace import trace_events_from_file
+
+                chrome_events.extend(trace_events_from_file(p))
+                continue
+            except (ValueError, json.JSONDecodeError):
+                pass  # a .json that is not a trace: fall through to JSONL
+        files = run_files(p) or [p]
+        for f in files:
+            try:
+                obs_events.extend(read_events(f))
+            except OSError as e:
+                log(f"warning: cannot read {f}: {e}")
+    return obs_events, chrome_events
+
+
+def trace_main(argv, log=print) -> int:
+    """The drift-attribution pass (``report trace``): sim-vs-real per-op
+    join + merged Perfetto trace."""
+    from flexflow_tpu.obs import trace as obstrace
+
+    out_dir = "."
+    paths = []
+    json_out = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-o", "--out"):
+            i += 1
+            if i >= len(argv):
+                raise SystemExit(f"flag {a!r} expects a value")
+            out_dir = argv[i]
+        elif a == "--json":
+            json_out = True
+        elif not a.startswith("-"):
+            paths.append(a)
+        i += 1
+    if not paths:
+        log(__doc__.strip())
+        return 2
+    events, chrome_events = _read_paths(paths, log)
+    sim_ops = obstrace.sim_op_seconds(events)
+    real_ops = obstrace.real_op_seconds(events)
+    drift = [e for e in events if e.get("kind") == "sim_drift"]
+    step = None
+    if drift:
+        d = drift[-1]
+        step = {"predicted_s": d.get("predicted_s"),
+                "measured_s": d.get("measured_s"),
+                "ratio": d.get("value"), "source": d.get("source")}
+    attribution = obstrace.drift_attribution(sim_ops, real_ops, step=step)
+    os.makedirs(out_dir, exist_ok=True)
+    attr_path = os.path.join(out_dir, "drift_attribution.json")
+    with open(attr_path, "w") as f:
+        json.dump(attribution, f, indent=1)
+    # merged trace: sim lanes (from trace files when given, else a
+    # sequential lane rebuilt from the per-op simulated seconds) next to
+    # the measured lanes from the op_time records
+    lanes = [chrome_events] if chrome_events else []
+    if not chrome_events and sim_ops:
+        lane = [obstrace.meta_event(obstrace.PID_SIM_BEST, "sim (per-op)"),
+                obstrace.meta_event(obstrace.PID_SIM_BEST,
+                               "ops (simulated)", 0)]
+        t = 0.0
+        for op in sorted(sim_ops, key=lambda o: -sim_ops[o]["seconds"]):
+            dur = sim_ops[op]["seconds"]
+            lane.append({"name": op, "cat": "compute", "ph": "X",
+                         "ts": t * 1e6, "dur": dur * 1e6,
+                         "pid": obstrace.PID_SIM_BEST, "tid": 0,
+                         "args": {"seconds": dur,
+                                  "op_kind": sim_ops[op].get("op_kind")}})
+            t += dur
+        lanes.append(lane)
+    lanes.append(obstrace.fit_trace_events(events))
+    merged = obstrace.chrome_trace(*lanes)
+    merged_path = os.path.join(out_dir, "merged.trace.json")
+    obstrace.write_trace(merged_path, merged)
+    if json_out:
+        log(json.dumps({"attribution": attribution,
+                        "attribution_path": attr_path,
+                        "merged_trace_path": merged_path}))
+        return 0
+    rows = attribution["ops"]
+    if rows:
+        log(f"drift attribution ({len(rows)} ops joined, "
+            f"sim {attribution['totals']['sim_s'] * 1e3:.3f} ms vs real "
+            f"{attribution['totals']['real_s'] * 1e3:.3f} ms):")
+        log(f"  {'op':<18s} {'kind':<14s} {'sim ms':>9s} {'real ms':>9s} "
+            f"{'drift ms':>9s} {'share':>6s}")
+        for r in rows[:20]:
+            log(f"  {r['op']:<18s} {str(r['op_kind'] or '?'):<14s} "
+                f"{r['sim_s'] * 1e3:>9.3f} {r['real_s'] * 1e3:>9.3f} "
+                f"{r['drift_s'] * 1e3:>+9.3f} {r['share']:>5.1%}")
+    else:
+        log("no joinable ops: need simulated per-op times (search -trace "
+            "or search_breakdown records) AND measured op_time records "
+            "(fit with --op-time-every N)")
+    for side, ops in (("sim-only", attribution["sim_only"]),
+                      ("real-only", attribution["real_only"])):
+        if ops:
+            log(f"  {side} (coverage gap): {', '.join(ops)}")
+    if step:
+        log(f"  step-level: predicted {step['predicted_s']}s vs measured "
+            f"{step['measured_s']}s (ratio {step['ratio']})")
+    log(f"written: {attr_path}, {merged_path}")
+    return 0
 
 
 def main(argv=None, log=print) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "trace":
+        return trace_main(argv[1:], log)
+    json_out = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:
         log(__doc__.strip())
         return 0 if paths or "-h" in argv or "--help" in argv else 2
-    from flexflow_tpu.obs import read_events
-    from flexflow_tpu.obs.report import render
-
-    events = []
-    for p in paths:
-        events.extend(read_events(p))
+    events, _ = _read_paths(paths, log)
     events.sort(key=lambda e: e.get("ts", 0.0))
-    log(render(events))
+    if json_out:
+        from flexflow_tpu.obs.report import summarize
+
+        log(json.dumps(summarize(events)))
+    else:
+        from flexflow_tpu.obs.report import render
+
+        log(render(events))
     return 0
 
 

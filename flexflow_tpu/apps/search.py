@@ -23,6 +23,15 @@ the delta re-simulation: ``on`` (default) prices each proposal in
 on divergence > 1e-9 (debug mode; the accepted sequence is identical in
 all three for a fixed seed).
 
+``-trace`` exports the simulated per-op timeline of the FINAL plan and
+the pure-DP baseline as one Chrome/Perfetto ``trace_event`` JSON
+(``<out-stem>.trace.json`` next to ``-o``, else
+``<obs-dir>/<run-id>.trace.json``) — per-op/per-point compute intervals,
+cross-device transfers with payload bytes, parameter-sync terms — and
+emits a ``sim_trace`` obs record with the per-op simulated seconds that
+``apps/report.py trace`` joins against measured ``op_time`` records for
+drift attribution (see obs/trace.py).
+
 Run telemetry (obs subsystem): ``-obs-dir DIR`` appends the structured
 event stream (search_space, per-chunk MCMC trajectory, search_result,
 per-op breakdown, pipeline + hlo_audit records) to
@@ -51,6 +60,7 @@ def parse_args(argv):
         "ici_group": None, "cache": "", "audit": None,
         "dtype": "float32", "dcn_calibration": "", "experts": 0,
         "obs_dir": "", "run_id": "", "chains": 1, "delta": "on",
+        "trace": False,
     }
     from flexflow_tpu.utils.flags import flag_stream
 
@@ -101,6 +111,11 @@ def parse_args(argv):
             # delta re-simulation: on (default) | off (full re-simulation
             # per proposal) | check (delta cross-checked vs full; debug)
             opts["delta"] = val()
+        elif a in ("-trace", "--trace"):
+            # export the simulated per-op timeline of the final plan AND
+            # the pure-DP baseline as a Chrome/Perfetto trace
+            # (ffsim_simulate_trace -> obs/trace.py)
+            opts["trace"] = True
     if opts["delta"] not in ("on", "off", "check"):
         raise SystemExit(f"-delta must be on|off|check, got "
                          f"{opts['delta']!r}")
@@ -153,6 +168,35 @@ def _audit_strategy(strategy, opts, machine, dp_known=None):
             dp_known=dp_known, experts=opts.get("experts", 0))
     finally:
         os.unlink(path)
+
+
+def _write_sim_trace(opts, search, info, olog, log):
+    """The -trace export: full simulated timelines of the FINAL plan and
+    the pure-DP baseline (two process lanes in one Perfetto-loadable
+    file), plus a ``sim_trace`` obs record carrying the per-op simulated
+    seconds — the join keys ``apps/report.py trace`` matches against
+    measured ``op_time`` records for drift attribution."""
+    from flexflow_tpu.obs import trace as obstrace
+
+    best = search.simulate_trace(info["assignment"])
+    dp = search.simulate_trace(search.dp_assignment())
+    if opts["out"]:
+        path = os.path.splitext(opts["out"])[0] + ".trace.json"
+    elif opts["obs_dir"] and olog.enabled:
+        path = os.path.join(opts["obs_dir"], f"{olog.run_id}.trace.json")
+    else:
+        path = f"{opts['model']}.trace.json"
+    obstrace.write_trace(path, obstrace.chrome_trace(
+        obstrace.sim_trace_events(best, pid=obstrace.PID_SIM_BEST,
+                                  label="sim:best"),
+        obstrace.sim_trace_events(dp, pid=obstrace.PID_SIM_DP,
+                                  label="sim:dp")))
+    olog.event("sim_trace", path=path, op_s=best["op_s"],
+               total_s=best["total_s"], dp_total_s=dp["total_s"],
+               opt_stream_s=best["opt_stream_s"])
+    log(f"sim trace written to {path} (sim:best + sim:dp lanes; open in "
+        f"ui.perfetto.dev)")
+    return path
 
 
 def _search_kw(opts):
@@ -357,6 +401,9 @@ def main(argv=None, log=print) -> dict:
         "cost_model": "measured" if opts["measured"] else "analytic",
         "batch_size": opts["batch_size"],
     }
+    if opts["trace"]:
+        result["trace_path"] = _write_sim_trace(opts, search, info, olog,
+                                                log)
     if olog.enabled:
         result["run_id"] = olog.run_id
         result["obs_path"] = olog.path

@@ -49,6 +49,16 @@ class FFConfig:
     # time+pid id; set it to join several processes into one stream.
     obs_dir: str = ""
     run_id: str = ""
+    # size cap of one obs JSONL file before rollover to a numbered
+    # sibling (<run>.jsonl.1, .2, ...); 0 = never rotate
+    obs_max_bytes: int = 64 * 1024 * 1024
+    # sampled per-op timing in fit() (obs/trace.py's measured side): every
+    # Nth step the run syncs and times forward/backward/optimizer
+    # sections (plus jax.profiler annotations), and isolated per-op shard
+    # timings are emitted post-loop — all as op_time records.  0 = off
+    # (the default; sampling perturbs the device pipeline on sampled
+    # steps).  Requires obs_dir.
+    op_time_every: int = 0
     # strategy search (sim/search.py): number of parallel MCMC chains and
     # the delta re-simulation mode — "on" (default), "off" (every proposal
     # pays a full re-simulation) or "check" (delta cross-checked against
@@ -115,6 +125,10 @@ class FFConfig:
                 cfg.obs_dir = val()
             elif a in ("-run-id", "--run-id"):
                 cfg.run_id = val()
+            elif a == "--obs-max-bytes":
+                cfg.obs_max_bytes = int(val())
+            elif a in ("-op-time-every", "--op-time-every"):
+                cfg.op_time_every = int(val())
             elif a in ("-chains", "--chains"):
                 cfg.search_chains = int(val())
             elif a in ("-delta", "--delta"):

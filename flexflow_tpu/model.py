@@ -1257,6 +1257,15 @@ class FFModel:
             from flexflow_tpu.utils.profiling import StepClock
 
             clock = StepClock()
+        # sampled per-op timing mode (obs/trace.py's measured side): every
+        # Nth step drains the pipeline and times forward / fwd+bwd /
+        # the real step, each host-synced, under jax.profiler
+        # annotations.  Off by default — sampling perturbs the device
+        # pipeline on sampled steps, so it is an explicit opt-in.
+        sample_every = max(int(getattr(self.config, "op_time_every", 0)
+                               or 0), 0) if olog.enabled else 0
+        sections = self._make_section_fns() if sample_every else None
+        op_samples = []
         start = time.perf_counter()
         loss = None
         with trace_ctx:
@@ -1267,8 +1276,13 @@ class FFModel:
                         float(loss)  # sync (block_until_ready is unreliable
                                      # under the axon tunnel)
                     start = time.perf_counter()
-                params, state, opt_state, loss = step(
-                    params, state, opt_state, *batch)
+                if sample_every and (it + 1) % sample_every == 0:
+                    params, state, opt_state, loss = self._sampled_step(
+                        step, sections, op_samples, it, loss,
+                        params, state, opt_state, batch)
+                else:
+                    params, state, opt_state, loss = step(
+                        params, state, opt_state, *batch)
                 losses.append(loss)
                 if clock is not None:
                     clock.tick()
@@ -1302,7 +1316,8 @@ class FFModel:
             self._emit_fit_records(olog, clock, losses, start_iter, warmup,
                                    num_iterations, elapsed, throughput,
                                    step, params, state, opt_state,
-                                   batch if losses else None)
+                                   batch if losses else None, op_samples,
+                                   sample_every)
         if self.config.profiling:
             # Flag-gated profiling report (reference: per-task cudaEvent ms
             # when `profiling` is set, conv_2d.cu:514-545).  Lead with the
@@ -1336,12 +1351,109 @@ class FFModel:
             "run_id": olog.run_id, "obs_path": olog.path,
         }
 
+    def _make_section_fns(self):
+        """Jitted forward and forward+backward sections of the train step
+        (the op-timing mode's section timers).  Pure — no donation, no
+        state/opt mutation — so a sampled step can time them against the
+        live params without advancing training."""
+        import jax
+        import jax.numpy as jnp
+
+        cdtype = self.config.compute_dtype
+
+        def cast(batch):
+            return [b.astype(cdtype)
+                    if hasattr(b, "dtype")
+                    and jnp.issubdtype(b.dtype, jnp.floating) else b
+                    for b in batch]
+
+        def fwd(params, state, *batch):
+            loss, _ = self.loss_fn(params, state, *cast(batch),
+                                   train=True)
+            return loss
+
+        def fwd_bwd(params, state, *batch):
+            def lf(p):
+                loss, _ = self.loss_fn(p, state, *cast(batch), train=True)
+                return loss
+
+            return jax.value_and_grad(lf)(params)
+
+        return jax.jit(fwd), jax.jit(fwd_bwd)
+
+    def _sampled_step(self, step, sections, op_samples, it, prev_loss,
+                      params, state, opt_state, batch):
+        """One step of the sampled op-timing mode: drain the async
+        pipeline, time the forward and forward+backward sections, then
+        run the REAL training step host-synced — backward and optimizer
+        times fall out by subtraction.  jax.profiler annotations bracket
+        each section so an XProf trace of the same run carries the
+        boundaries.  Raw samples are buffered; op_time records are
+        written after the timed loop."""
+        import jax
+
+        fwd, fwd_bwd = sections
+        if prev_loss is not None:
+            float(prev_loss)  # sync (block_until_ready is unreliable
+            #                   under the axon tunnel)
+        rec = {"step": it + 1}
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("op_time:forward"):
+            float(fwd(params, state, *batch))
+        rec["forward"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation("op_time:forward_backward"):
+            loss_g = fwd_bwd(params, state, *batch)
+            float(loss_g[0])
+            jax.block_until_ready(loss_g[1])
+        rec["forward_backward"] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with jax.profiler.StepTraceAnnotation("train", step_num=it + 1):
+            out = step(params, state, opt_state, *batch)
+        float(out[3])  # loss, the step's dependency-chain tail
+        rec["step_s"] = time.perf_counter() - t0
+        op_samples.append(rec)
+        return out
+
+    def _emit_op_times(self, olog, op_samples):
+        """The op_time records of one sampled run: per-sample section
+        timings (backward/optimizer by subtraction, clamped at 0 — a
+        sampled wall can jitter below its contained section) and one
+        isolated per-op shard timing per layer under its executed config
+        — the join keys drift attribution matches against the simulated
+        per-op times."""
+        for s in op_samples:
+            fw = s.get("forward", 0.0)
+            fb = s.get("forward_backward", 0.0)
+            st = s.get("step_s", 0.0)
+            for name, secs in (("forward", fw),
+                               ("backward", max(fb - fw, 0.0)),
+                               ("optimizer", max(st - fb, 0.0)),
+                               ("step", st)):
+                olog.event("op_time", scope="section", section=name,
+                           step=s["step"], seconds=secs)
+        from flexflow_tpu.sim.cost_model import AnalyticCostModel
+        from flexflow_tpu.utils.profiling import time_op_shard
+
+        analytic = AnalyticCostModel()
+        for op in self.layers:
+            t = time_op_shard(op, op.pc,
+                              dtype=self.config.compute_dtype)
+            measured = t is not None
+            if not measured:  # unrealizable shard: analytic stand-in
+                t = analytic.op_cost(op, op.pc)
+            olog.event("op_time", scope="op", op=op.name,
+                       op_kind=type(op).__name__, grid=list(op.pc.dims),
+                       seconds=t, measured=measured)
+
     def _emit_fit_records(self, olog, clock, losses, start_iter, warmup,
                           num_iterations, elapsed, throughput,
-                          step, params, state, opt_state, batch):
+                          step, params, state, opt_state, batch,
+                          op_samples=(), sample_every=0):
         """Write the fit surface's obs records (compile, per-step, summary,
-        sim_drift).  Runs strictly AFTER the timed loop — the only
-        in-loop obs cost is StepClock.tick()."""
+        op_time, sim_drift).  Runs strictly AFTER the timed loop — the
+        only in-loop obs costs are StepClock.tick() and, when the
+        op-timing mode is on, the sampled steps' explicit syncs."""
         bsz = self.config.batch_size
         # one-time compile record: the first call's wall time is the
         # host-observable compile cost (trace + partition + XLA compile +
@@ -1371,8 +1483,20 @@ class FFModel:
                    warmup=warmup - start_iter, elapsed_s=elapsed,
                    images_per_sec=throughput,
                    final_loss=losses[-1] if losses else None)
+        if sample_every and op_samples:
+            self._emit_op_times(olog, op_samples)
+        # sim_drift, or an explicit record of WHY it is missing — a
+        # silently absent gauge reads as "no drift" (round-1 satellite)
         n_timed = num_iterations - warmup
-        if self.config.strategies and n_timed > 0 and elapsed > 0:
+        if not self.config.strategies:
+            olog.event("sim_drift_unavailable",
+                       reason="no strategy loaded (pure-DP default run; "
+                              "no simulator prediction to compare)")
+        elif n_timed <= 0 or elapsed <= 0:
+            olog.event("sim_drift_unavailable",
+                       reason="no timed steps (every iteration was "
+                              "warmup)")
+        else:
             self._emit_sim_drift(olog, elapsed / n_timed)
 
     def _emit_sim_drift(self, olog, measured_step_s):
@@ -1397,13 +1521,19 @@ class FFModel:
                     ss.assignment_for(self.config.strategies))
                 source = "analytic"
             except Exception as e:
-                olog.event("sim_drift_unavailable", error=str(e))
+                olog.event("sim_drift_unavailable", error=str(e),
+                           reason=f"simulating the loaded strategy "
+                                  f"failed: {e}")
                 return
         if predicted_s and predicted_s > 0:
             olog.event("sim_drift", name="sim_drift",
                        value=measured_step_s / predicted_s,
                        predicted_s=predicted_s,
                        measured_s=measured_step_s, source=source)
+        else:
+            olog.event("sim_drift_unavailable",
+                       reason="artifact carries a non-positive "
+                              "prediction")
 
     def summary(self) -> str:
         lines = [f"FFModel: {len(self.layers)} layers, "

@@ -115,9 +115,12 @@ struct OpNode {
 // cost == 0 -> same-device dependency (producer must finish first);
 // cost > 0  -> cross-device transfer, latency + bytes/bw precomputed so
 // neither the full nor the delta path re-derives rectangle intersections.
+// `bytes` is the transfer payload (intersection volume * 4), kept for the
+// trace exporter; the hot paths read only `cost`.
 struct Hop {
   int src_point, dst_point;
   double cost;
+  double bytes;
 };
 
 struct Simulator {
@@ -158,11 +161,12 @@ struct Simulator {
         int64_t v = intersect_volume(sp[i].out, need);
         if (v <= 0) continue;
         if (sp[i].device == dp[j].device)
-          plan->push_back({(int)i, (int)j, 0.0});
+          plan->push_back({(int)i, (int)j, 0.0, 0.0});
         else
           plan->push_back({(int)i, (int)j,
                            latency + (double)v * 4.0 /
-                               bw(sp[i].device, dp[j].device)});
+                               bw(sp[i].device, dp[j].device),
+                           (double)v * 4.0});
       }
     }
     std::unique_lock<std::shared_mutex> wl(edge_mu);
@@ -248,6 +252,88 @@ struct Simulator {
     double sync = 0.0;
     for (size_t o = 0; o < n; o++) sync += sync_of((int)o, assign[o]);
     return makespan + sync;
+  }
+
+  // One exported timeline record (ffsim_simulate_trace).  Flat doubles so
+  // the ctypes consumer reshapes to (n, TRACE_STRIDE) without a struct
+  // mirror.  kind 0 = compute interval of one grid point; kind 1 = a
+  // cross-device transfer (hop with cost > 0); kind 2 = the op's
+  // parameter-sync term (laid after the makespan — it overlaps all
+  // devices, so it gets no device lane).
+  static constexpr int TRACE_STRIDE = 8;
+  enum { TRACE_COMPUTE = 0, TRACE_XFER = 1, TRACE_SYNC = 2 };
+
+  // Full simulation of `assign` that exports the schedule: same greedy
+  // list-scheduling arithmetic as simulate()/run_op (kept separate so the
+  // MCMC hot path stays untouched), but every scheduled interval is
+  // emitted.  Writes at most `cap` records into `out` (records beyond the
+  // capacity are counted, not written — callers probe with cap = 0, then
+  // allocate); returns the total record count and stores makespan + sync
+  // in *total_s.  Record layout per TRACE_STRIDE doubles:
+  //   [0] kind  [1] op id  [2] point (compute) / src device (xfer) / -1
+  //   [3] device (compute) / dst device (xfer) / -1
+  //   [4] start sec  [5] duration sec  [6] payload bytes (xfer only)
+  //   [7] the op's config index under `assign`
+  int64_t simulate_trace(const std::vector<int>& assign, double* out,
+                         int64_t cap, double* total_s) {
+    size_t n = ops.size();
+    std::vector<std::vector<double>> finish(n);
+    std::vector<double> dev_free(n_devices, 0.0);
+    double makespan = 0.0;
+    int64_t cnt = 0;
+    auto emit = [&](double kind, double op, double a, double b,
+                    double start, double dur, double bytes, double cfg) {
+      if (cnt < cap) {
+        double* r = out + cnt * TRACE_STRIDE;
+        r[0] = kind; r[1] = op; r[2] = a; r[3] = b;
+        r[4] = start; r[5] = dur; r[6] = bytes; r[7] = cfg;
+      }
+      cnt++;
+    };
+    for (size_t o = 0; o < n; o++) {
+      int ci = assign[o];
+      const Config& cfg = ops[o].configs[ci];
+      size_t np = cfg.points.size();
+      std::vector<double> ready(np, 0.0);
+      for (size_t inp = 0; inp < ops[o].producers.size(); inp++) {
+        int src = ops[o].producers[inp];
+        if (src < 0) continue;
+        const std::vector<double>& sf = finish[src];
+        const auto& sp = ops[src].configs[assign[src]].points;
+        for (const Hop& h : edge_plan((int)o, (int)inp, assign[src], ci)) {
+          double t = sf[h.src_point] + h.cost;
+          if (t > ready[h.dst_point]) ready[h.dst_point] = t;
+          if (h.cost > 0.0)  // the transfer occupies [src finish, +cost)
+            emit(TRACE_XFER, (double)o, (double)sp[h.src_point].device,
+                 (double)cfg.points[h.dst_point].device, sf[h.src_point],
+                 h.cost, h.bytes, (double)ci);
+        }
+      }
+      double per_point = cfg.compute_cost + cfg.collective_cost;
+      finish[o].resize(np);
+      for (size_t j = 0; j < np; j++) {
+        int d = cfg.points[j].device;
+        double start = ready[j] > dev_free[d] ? ready[j] : dev_free[d];
+        double end = start + per_point;
+        dev_free[d] = end;
+        finish[o][j] = end;
+        if (end > makespan) makespan = end;
+        emit(TRACE_COMPUTE, (double)o, (double)j, (double)d, start,
+             per_point, 0.0, (double)ci);
+      }
+    }
+    double sync = 0.0, at = makespan;
+    for (size_t o = 0; o < n; o++) {
+      double s = sync_of((int)o, assign[o]);
+      if (s > 0.0) {  // serialized after the makespan, full-path order
+        emit(TRACE_SYNC, (double)o, -1.0, -1.0, at, s, 0.0,
+             (double)assign[o]);
+        at += s;
+      }
+      sync += s;
+    }
+    if (total_s) *total_s = makespan + sync;
+    return cnt;
   }
 };
 
@@ -645,6 +731,20 @@ double ffsim_simulate(void* handle, const int32_t* assign) {
   std::vector<int> a(sim->ops.size());
   for (size_t i = 0; i < a.size(); i++) a[i] = assign[i];
   return sim->simulate(a);
+}
+
+// Full simulate of `assign` that exports the per-op/per-point/per-hop
+// timeline (the Perfetto trace source — obs/trace.py).  Two-call
+// protocol: cap = 0 probes the record count, the second call fills
+// `out` (Simulator::TRACE_STRIDE doubles per record; layout documented
+// there).  `total_s` (optional) receives makespan + sync, equal to
+// ffsim_simulate on the same assignment.
+int64_t ffsim_simulate_trace(void* handle, const int32_t* assign,
+                             double* out, int64_t cap, double* total_s) {
+  Simulator* sim = (Simulator*)handle;
+  std::vector<int> a(sim->ops.size());
+  for (size_t i = 0; i < a.size(); i++) a[i] = assign[i];
+  return sim->simulate_trace(a, out, cap, total_s);
 }
 
 // Delta-state lifecycle for callers that drive proposals themselves (the

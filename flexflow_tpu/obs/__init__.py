@@ -21,9 +21,16 @@ fished out of mixed stdout.  This package gives them ONE record schema:
   * :class:`RunLog` is the thread-safe sink; :class:`NullRunLog` (the
     module-level ``NULL``) is the disabled sink whose every method is a
     no-op, so instrumented code pays one predicate/attribute check when
-    ``FFConfig.obs_dir`` is unset;
-  * :func:`read_events` is the reader; ``apps/report.py`` renders a run
-    back into the summary tables humans read today.
+    ``FFConfig.obs_dir`` is unset.  Event files are capped: when the
+    current file reaches ``max_bytes`` the stream rolls over to a
+    monotonically numbered sibling (``run.jsonl.1``, ``.2``, ...), so a
+    long training run with per-op sampling enabled cannot grow one
+    unbounded file;
+  * :func:`read_events` is the single-file reader, :func:`run_files` /
+    :func:`read_run` walk a rotated stream in write order;
+    ``apps/report.py`` renders a run back into the summary tables humans
+    read today, and ``obs/trace.py`` exports per-op timelines as
+    Chrome/Perfetto traces with sim-vs-real drift attribution.
 
 Telemetry is strictly OFF the device hot path: records carry host-side
 timestamps only and no instrumentation site may introduce a device sync
@@ -41,6 +48,10 @@ import time
 from typing import Any, Dict, Iterator, Optional
 
 SCHEMA_VERSION = 1
+
+# default size cap of one event file before rollover (64 MB); 0 disables
+# rotation.  FFConfig.obs_max_bytes overrides per run.
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
 
 
 def new_run_id() -> str:
@@ -99,7 +110,12 @@ class RunLog:
     enabled = True
 
     def __init__(self, path: str, run_id: Optional[str] = None,
-                 surface: str = "", meta: Optional[Dict[str, Any]] = None):
+                 surface: str = "", meta: Optional[Dict[str, Any]] = None,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        """``path`` is the stream's BASE file; once the current file
+        reaches ``max_bytes`` (0 = never) writes continue in
+        ``path.<n>`` with n increasing monotonically.  Re-opening an
+        already-rotated stream resumes at its newest part."""
         self.path = path
         self.run_id = run_id or new_run_id()
         self.surface = surface
@@ -107,9 +123,16 @@ class RunLog:
         if d:
             os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
-        self._f = open(path, "a")
+        self._max_bytes = max(int(max_bytes or 0), 0)
+        self._seq = 0
+        while os.path.exists(f"{path}.{self._seq + 1}"):
+            self._seq += 1
+        self._f = open(self._part_path(), "a")
         self.event("run_start", schema=SCHEMA_VERSION,
                    **(dict(meta) if meta else {}))
+
+    def _part_path(self) -> str:
+        return self.path if self._seq == 0 else f"{self.path}.{self._seq}"
 
     # -- core emitters --------------------------------------------------
 
@@ -124,6 +147,12 @@ class RunLog:
                 return
             self._f.write(line + "\n")
             self._f.flush()
+            if self._max_bytes and self._f.tell() >= self._max_bytes:
+                # size-based rollover: close the full part, continue in
+                # the next numbered sibling (readers walk run_files())
+                self._f.close()
+                self._seq += 1
+                self._f = open(self._part_path(), "a")
 
     def counter(self, name: str, value: float = 1, **fields) -> None:
         self.event("counter", name=name, value=value, **fields)
@@ -179,7 +208,28 @@ def from_config(config, surface: str = "",
         return NULL
     run_id = getattr(config, "run_id", "") or new_run_id()
     return RunLog(os.path.join(obs_dir, f"{run_id}.jsonl"),
-                  run_id=run_id, surface=surface, meta=meta)
+                  run_id=run_id, surface=surface, meta=meta,
+                  max_bytes=getattr(config, "obs_max_bytes",
+                                    DEFAULT_MAX_BYTES))
+
+
+def run_files(path: str) -> list:
+    """A run stream's files in write order: the base ``path`` plus its
+    rotated parts ``path.1``, ``path.2``, ...  (``path`` itself may
+    legitimately be missing when a caller points at a rotated part
+    directly — only existing files are returned)."""
+    out = [path] if os.path.exists(path) else []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        out.append(f"{path}.{i}")
+        i += 1
+    return out
+
+
+def read_run(path: str) -> Iterator[Dict[str, Any]]:
+    """All records of a possibly-rotated run stream, in write order."""
+    for p in run_files(path):
+        yield from read_events(p)
 
 
 def read_events(path: str) -> Iterator[Dict[str, Any]]:

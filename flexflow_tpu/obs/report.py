@@ -61,7 +61,11 @@ def _fit_section(events: List[Dict]) -> List[str]:
     ckpts = [e for e in events
              if e.get("kind") in ("checkpoint_save", "checkpoint_restore")]
     drift = [e for e in events if e.get("kind") == "sim_drift"]
-    if not (steps or compiles or summaries):
+    no_drift = [e for e in events
+                if e.get("kind") == "sim_drift_unavailable"]
+    op_times = [e for e in events if e.get("kind") == "op_time"]
+    if not (steps or compiles or summaries or op_times or drift
+            or no_drift):
         return []
     lines = ["== training =="]
     for c in compiles:
@@ -91,12 +95,44 @@ def _fit_section(events: List[Dict]) -> List[str]:
     for c in ckpts:
         lines.append(f"  {c['kind']}: step {c.get('step', '?')} "
                      f"({c.get('seconds', 0.0):.3f}s)")
+    if op_times:
+        sections = [e for e in op_times if e.get("scope") == "section"]
+        per_op = [e for e in op_times if e.get("scope") == "op"]
+        if sections:
+            by_name: Dict[str, List[float]] = {}
+            for e in sections:
+                by_name.setdefault(str(e.get("section")), []).append(
+                    float(e.get("seconds", 0.0)))
+            parts = []
+            for name in ("forward", "backward", "optimizer", "step"):
+                vals = sorted(by_name.get(name, []))
+                if vals:
+                    parts.append(
+                        f"{name} {_fmt_s(vals[len(vals) // 2])}")
+            n_steps = len({e.get("step") for e in sections})
+            lines.append(f"  op_time sections ({n_steps} sampled steps, "
+                         f"median): " + ", ".join(parts))
+        if per_op:
+            lines.append(f"  op_time per-op (isolated shard, "
+                         f"{len(per_op)} records):")
+            rows = sorted(per_op, key=lambda e: -e.get("seconds", 0.0))
+            for e in rows[:12]:
+                mark = "" if e.get("measured") else "~"
+                lines.append(
+                    f"    {str(e.get('op', '?')):<18s} "
+                    f"{str(e.get('op_kind', '?')):<14s} "
+                    f"{mark}{_fmt_s(e.get('seconds', 0.0))}")
     for d in drift:
         lines.append(
             f"  sim_drift: predicted {_fmt_s(d.get('predicted_s', 0.0))} "
             f"vs measured {_fmt_s(d.get('measured_s', 0.0))} "
             f"-> ratio {d.get('value', 0.0):.3f} "
             f"[{d.get('source', '?')}]")
+    for u in no_drift:
+        # say WHY the gauge is missing — a silently absent sim_drift
+        # reads as "no drift", which is exactly wrong
+        lines.append("  sim_drift unavailable: "
+                     f"{u.get('reason') or u.get('error') or '?'}")
     return lines
 
 
@@ -190,9 +226,24 @@ def _audit_bench_section(events: List[Dict]) -> List[str]:
     return lines
 
 
+def _trace_section(events: List[Dict]) -> List[str]:
+    traces = [e for e in events if e.get("kind") == "sim_trace"]
+    if not traces:
+        return []
+    lines = ["== traces =="]
+    for t in traces:
+        lines.append(
+            f"  sim trace: {t.get('path', '?')} "
+            f"(best {_fmt_s(t.get('total_s', 0.0))} vs dp "
+            f"{_fmt_s(t.get('dp_total_s', 0.0))}; open in "
+            f"ui.perfetto.dev)")
+    return lines
+
+
 def _misc_section(events: List[Dict]) -> List[str]:
     known = {"run_start", "compile", "step", "summary", "checkpoint_save",
-             "checkpoint_restore", "sim_drift", "search_space",
+             "checkpoint_restore", "sim_drift", "sim_drift_unavailable",
+             "op_time", "sim_trace", "search_space",
              "search_chunk", "search_result", "search_breakdown",
              "pipeline_candidate", "pipeline_decision", "hlo_audit",
              "bench"}
@@ -222,7 +273,7 @@ def render(events: Iterable[Dict]) -> str:
         return "(empty run log)"
     sections = [_header(events), _fit_section(events),
                 _search_section(events), _audit_bench_section(events),
-                _misc_section(events)]
+                _trace_section(events), _misc_section(events)]
     return "\n".join("\n".join(s) for s in sections if s)
 
 
@@ -230,3 +281,131 @@ def render_file(path: str) -> str:
     from flexflow_tpu.obs import read_events
 
     return render(read_events(path))
+
+
+def _median(values: List[float]) -> float:
+    values = sorted(values)
+    return values[len(values) // 2] if values else 0.0
+
+
+def summarize(events: Iterable[Dict]) -> Dict:
+    """The machine-readable counterpart of :func:`render` (the report
+    CLI's ``--json`` output): one JSON-serializable object per stream so
+    CI and bench tooling consume fields instead of scraping prose.  Only
+    sections whose record kinds are present appear."""
+    events = list(events)
+    kinds: Dict[str, int] = {}
+    for e in events:
+        kinds[str(e.get("kind"))] = kinds.get(str(e.get("kind")), 0) + 1
+    out: Dict = {
+        "runs": sorted({str(e["run"]) for e in events if e.get("run")}),
+        "surfaces": sorted({e["surface"] for e in events
+                            if e.get("surface")}),
+        "records": len(events),
+        "kinds": kinds,
+    }
+    meta = {}
+    for e in events:
+        if e.get("kind") == "run_start":
+            meta.update({k: v for k, v in e.items()
+                         if k not in ("run", "ts", "kind", "surface",
+                                      "schema")})
+    if meta:
+        out["meta"] = meta
+    steps = [e for e in events if e.get("kind") == "step"]
+    summaries = [e for e in events if e.get("kind") == "summary"]
+    compiles = [e for e in events if e.get("kind") == "compile"]
+    if steps or summaries or compiles:
+        walls = [e["wall_ms"] for e in steps if "wall_ms" in e]
+        losses = [e["loss"] for e in steps if e.get("loss") is not None]
+        tr: Dict = {"steps": len(steps)}
+        if compiles:
+            tr["compile_s"] = compiles[0].get("seconds", 0.0)
+            if compiles[0].get("flops"):
+                tr["flops_per_step"] = compiles[0]["flops"]
+        if walls:
+            tr["wall_ms"] = {"min": min(walls),
+                             "mean": sum(walls) / len(walls),
+                             "max": max(walls)}
+        if losses:
+            tr["loss"] = {"first": float(losses[0]),
+                          "final": float(losses[-1])}
+        if summaries:
+            s = summaries[-1]
+            tr["elapsed_s"] = s.get("elapsed_s", 0.0)
+            tr["images_per_sec"] = s.get("images_per_sec", 0.0)
+        out["training"] = tr
+    drift = [e for e in events if e.get("kind") == "sim_drift"]
+    if drift:
+        d = drift[-1]
+        out["sim_drift"] = {"value": d.get("value"),
+                            "predicted_s": d.get("predicted_s"),
+                            "measured_s": d.get("measured_s"),
+                            "source": d.get("source"),
+                            "n": len(drift)}
+    no_drift = [e for e in events
+                if e.get("kind") == "sim_drift_unavailable"]
+    if no_drift:
+        out["sim_drift_unavailable"] = [
+            e.get("reason") or e.get("error") or "?" for e in no_drift]
+    op_times = [e for e in events if e.get("kind") == "op_time"]
+    if op_times:
+        sections = [e for e in op_times if e.get("scope") == "section"]
+        per_op = [e for e in op_times if e.get("scope") == "op"]
+        ot: Dict = {}
+        if sections:
+            by_name: Dict[str, List[float]] = {}
+            for e in sections:
+                by_name.setdefault(str(e.get("section")), []).append(
+                    float(e.get("seconds", 0.0)))
+            ot["sections_median_s"] = {k: _median(v)
+                                       for k, v in by_name.items()}
+            ot["sampled_steps"] = len({e.get("step") for e in sections})
+        if per_op:
+            ot["ops"] = {str(e.get("op")): {
+                "seconds": e.get("seconds"),
+                "op_kind": e.get("op_kind"),
+                "measured": e.get("measured")} for e in per_op}
+        out["op_time"] = ot
+    space = [e for e in events if e.get("kind") == "search_space"]
+    chunks = [e for e in events if e.get("kind") == "search_chunk"]
+    results = [e for e in events if e.get("kind") == "search_result"]
+    if space or chunks or results:
+        se: Dict = {}
+        if space:
+            se["space"] = {k: space[-1].get(k) for k in
+                           ("ops", "candidates", "axis_options_pruned",
+                            "mem_rejected", "devices", "cost_model")}
+        if chunks:
+            curve = [c["best_time_s"] for c in chunks
+                     if "best_time_s" in c]
+            acc = sum(c.get("accepted", 0) for c in chunks)
+            prop = sum(c.get("proposed", 0) for c in chunks)
+            se["chunks"] = len(chunks)
+            if curve:
+                se["best_time_s"] = {"first": curve[0], "last": curve[-1]}
+            se["accept_rate"] = acc / prop if prop else 0.0
+        if results:
+            r = results[-1]
+            se["result"] = {k: r.get(k) for k in
+                            ("dp_time_s", "best_time_s", "speedup_vs_dp",
+                             "iters", "chains", "delta_hit_rate",
+                             "proposals_per_sec")}
+        out["search"] = se
+    audits = [e for e in events if e.get("kind") == "hlo_audit"]
+    if audits:
+        out["hlo_audit"] = [{k: v for k, v in a.items()
+                             if k not in ("run", "ts", "kind", "surface")}
+                            for a in audits]
+    benches = [e for e in events if e.get("kind") == "bench"]
+    if benches:
+        out["bench"] = [{k: v for k, v in b.items()
+                         if k not in ("run", "ts", "kind", "surface")}
+                        for b in benches]
+    traces = [e for e in events if e.get("kind") == "sim_trace"]
+    if traces:
+        out["sim_trace"] = [{"path": t.get("path"),
+                             "total_s": t.get("total_s"),
+                             "dp_total_s": t.get("dp_total_s")}
+                            for t in traces]
+    return out

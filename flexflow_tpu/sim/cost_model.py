@@ -134,14 +134,26 @@ class MeasuredCostModel:
     def __init__(self, cache_path: Optional[str] = None,
                  fallback: Optional[AnalyticCostModel] = None,
                  repeats: int = 5, chain: int = 8, save_every: int = 32,
-                 dtype: str = "float32"):
+                 dtype: str = "float32",
+                 anchors: Optional[Dict[str, float]] = None,
+                 anchors_path: Optional[str] = None):
         """``repeats`` = timed invocations (min taken); ``chain`` = op
         applications dependency-chained inside each invocation (amortizes
         the tunnel's dispatch latency, see _measure).  ``dtype`` is the
         compute dtype the shard computations are timed in — calibration
         against a bf16 training step must measure bf16 shard kernels
         (MXU bf16 peak is ~4x f32); f32 keeps round-2 cache entries
-        valid."""
+        valid.
+
+        ``anchors`` / ``anchors_path`` seed the per-kind measured/analytic
+        ratios from a prior run instead of waiting for in-build
+        measurements — the drift-recalibration loop:
+        ``apps/calibrate.py --from-obs`` refits them from accumulated
+        op_time/sim_drift records and writes the artifact
+        (``kind_anchors``) this reads, so a chip-free search still ranks
+        unmeasurable candidates on the measured scale.  In-build
+        measurements append to the seeded lists, so live data gradually
+        outvotes a stale artifact."""
         self.cache_path = cache_path
         self.repeats = max(1, repeats)
         self.chain = max(1, chain)
@@ -151,6 +163,15 @@ class MeasuredCostModel:
         self._dirty = 0
         self._warned_kinds = set()
         self._kind_ratios: Dict[str, list] = {}
+        if anchors_path:
+            with open(anchors_path) as f:
+                loaded_anchors = json.load(f)
+            loaded_anchors = loaded_anchors.get("kind_anchors",
+                                                loaded_anchors)
+            for k, v in loaded_anchors.items():
+                self._kind_ratios[str(k)] = [float(v)]
+        for k, v in (anchors or {}).items():
+            self._kind_ratios[str(k)] = [float(v)]
         # keys that already contributed a ratio: cache-hit lookups for
         # identically-keyed ops must not append duplicates, which would
         # skew the per-kind median toward repeated shapes (round-3 ADVICE)

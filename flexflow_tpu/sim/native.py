@@ -36,6 +36,12 @@ def _load():
     lib.ffsim_simulate.restype = ctypes.c_double
     lib.ffsim_simulate.argtypes = [ctypes.c_void_p,
                                    ctypes.POINTER(ctypes.c_int32)]
+    lib.ffsim_simulate_trace.restype = ctypes.c_int64
+    lib.ffsim_simulate_trace.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_int32),
+                                         ctypes.POINTER(ctypes.c_double),
+                                         ctypes.c_int64,
+                                         ctypes.POINTER(ctypes.c_double)]
     lib.ffsim_mcmc.restype = ctypes.c_double
     lib.ffsim_mcmc.argtypes = [ctypes.c_void_p,
                                ctypes.POINTER(ctypes.c_int32),
@@ -153,6 +159,46 @@ class NativeSimulator:
         assert len(a) == self.n_ops
         return lib.ffsim_simulate(
             self._handle, a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+
+    # one exported timeline record is TRACE_STRIDE doubles (simulator.cc
+    # Simulator::TRACE_STRIDE); kinds match the TRACE_* enum there
+    TRACE_STRIDE = 8
+    TRACE_KINDS = ("compute", "transfer", "sync")
+
+    def simulate_trace(self, assignment: Sequence[int]):
+        """Full simulation of ``assignment`` exporting the schedule as
+        interval records (the Perfetto trace source).  Returns
+        ``(records, total_s)`` where ``total_s`` equals
+        :meth:`simulate` on the same assignment and each record is
+        ``{"kind": "compute"|"transfer"|"sync", "op": int, "cfg": int,
+        "start": s, "dur": s, ...}`` — compute records carry
+        ``point``/``device``, transfer records ``src_device``/
+        ``dst_device``/``bytes``."""
+        lib = _load()
+        a = np.ascontiguousarray(assignment, dtype=np.int32)
+        assert len(a) == self.n_ops
+        total = np.zeros(1, dtype=np.float64)
+        null = ctypes.POINTER(ctypes.c_double)()
+        n = lib.ffsim_simulate_trace(self._handle, _i32(a), null, 0,
+                                     _f64(total))
+        buf = np.zeros((max(int(n), 1), self.TRACE_STRIDE),
+                       dtype=np.float64)
+        lib.ffsim_simulate_trace(self._handle, _i32(a), _f64(buf), n,
+                                 _f64(total))
+        records = []
+        for row in buf[:n]:
+            kind = self.TRACE_KINDS[int(row[0])]
+            rec = {"kind": kind, "op": int(row[1]), "cfg": int(row[7]),
+                   "start": float(row[4]), "dur": float(row[5])}
+            if kind == "compute":
+                rec["point"] = int(row[2])
+                rec["device"] = int(row[3])
+            elif kind == "transfer":
+                rec["src_device"] = int(row[2])
+                rec["dst_device"] = int(row[3])
+                rec["bytes"] = float(row[6])
+            records.append(rec)
+        return records, float(total[0])
 
     def mcmc(self, assignment: Sequence[int], iters: int = 250_000,
              beta: float = 5e3, seed: int = 0):

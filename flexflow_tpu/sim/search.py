@@ -697,6 +697,41 @@ class StrategySearch:
     def simulate(self, assignment: Sequence[int]) -> float:
         return self.sim.simulate(assignment) + self._opt_stream_s
 
+    def simulate_trace(self, assignment: Sequence[int]) -> dict:
+        """Full simulation of ``assignment`` exporting the schedule with
+        op names attached (ffsim_simulate_trace) — the simulated-timeline
+        producer behind ``apps/search.py -trace`` / obs/trace.py.  Returns
+        ``{"events": [...], "op_s": {name: per-shard seconds},
+        "makespan_sync_s", "opt_stream_s", "total_s"}``; ``total_s``
+        equals :meth:`simulate` on the same assignment.  ``op_s`` is each
+        op's per-shard compute + in-op collective time under its assigned
+        config — the join key the drift-attribution pass matches against
+        measured ``op_time`` records."""
+        records, raw = self.sim.simulate_trace(assignment)
+        events = []
+        op_s: Dict[str, float] = {}
+        for r in records:
+            op = self.ops[r["op"]]
+            ev = dict(r)
+            ev["op"] = op.name
+            ev["op_kind"] = type(op).__name__
+            if not isinstance(op, _InputSource):
+                if r["kind"] == "compute":
+                    op_s[op.name] = max(op_s.get(op.name, 0.0), r["dur"])
+            events.append(ev)
+        # the assignment-invariant optimizer parameter stream, laid after
+        # everything the native schedule contains (same term simulate()
+        # adds on top of the raw makespan + sync)
+        if self._opt_stream_s > 0.0:
+            events.append({"kind": "sync", "op": "_opt_stream",
+                           "op_kind": "OptStream", "cfg": -1,
+                           "start": raw, "dur": self._opt_stream_s})
+        return {"events": events, "op_s": op_s,
+                "makespan_sync_s": raw,
+                "opt_stream_s": self._opt_stream_s,
+                "total_s": raw + self._opt_stream_s,
+                "devices": self.machine.num_devices}
+
     def propose_pipeline(self, stage_options=None,
                          micro_options=(2, 4, 8), log=None,
                          reference_s=None, stage_divisor=None,

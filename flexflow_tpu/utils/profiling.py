@@ -67,6 +67,61 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
+def time_op_shard(op, pc, dtype: str = "float32",
+                  repeats: int = 3) -> Optional[float]:
+    """Wall seconds of ONE shard's jitted fwd+grad for ``op`` under
+    ``pc`` (shard-local shapes via ``local_clone``), min over
+    ``repeats`` timed calls after a warm-up — the measured side of the
+    obs ``op_time`` records (fit's sampled op-timing mode) and of the
+    drift-attribution join in obs/trace.py.
+
+    Deliberately simpler than MeasuredCostModel._measure: a single
+    host-synced call per repeat, no chained-scan differencing — the
+    sampler runs in-process on the training host where dispatch overhead
+    is small, and attribution needs relative per-op scale, not
+    protocol-v3 absolute precision.  None when the shard cannot be
+    realized locally (caller falls back to the analytic roofline)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    local = op.local_clone(pc)
+    if local is None:
+        return None
+    try:
+        params = local.init_params(jax.random.PRNGKey(0))
+        xs = [jnp.zeros(t.shape, "int32") if t.dtype == "int32"
+              else jnp.ones(t.shape, dtype) for t in local.inputs]
+        state = local.init_state()
+
+        def loss_of(p, xs_):
+            res, _ = local.forward(p, state, xs_, True)
+            res = res[0] if isinstance(res, tuple) else res
+            return (res.astype("float32") ** 2).sum()
+
+        if params:
+            fn = jax.jit(lambda p, xs_: jax.grad(loss_of)(p, xs_))
+            args = (params, xs)
+        elif op.inputs and op.inputs[0].dtype != "int32":
+            fn = jax.jit(lambda xs_: jax.grad(
+                lambda x: loss_of({}, x))(list(xs_)))
+            args = (xs,)
+        else:
+            fn = jax.jit(lambda xs_: loss_of({}, xs_))
+            args = (xs,)
+        jax.block_until_ready(fn(*args))  # compile + warm
+        best = None
+        for _ in range(max(repeats, 1)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best if best and best > 0 else None
+    except Exception:
+        return None
+
+
 @dataclasses.dataclass
 class OpProfile:
     name: str

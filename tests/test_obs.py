@@ -102,6 +102,37 @@ def test_null_log_is_inert_and_cheap(tmp_path):
     live.close()
 
 
+def test_runlog_rotation(tmp_path):
+    from flexflow_tpu.obs import read_run, run_files
+
+    path = str(tmp_path / "rot.jsonl")
+    ol = RunLog(path, run_id="rr", max_bytes=400)
+    for i in range(50):
+        ol.event("tick", i=i, pad="x" * 40)
+    ol.close()
+    files = run_files(path)
+    assert len(files) > 1, "400-byte cap must have rolled the stream"
+    assert files[0] == path and files[1] == path + ".1"
+    # nothing lost, order preserved across parts
+    ticks = [e["i"] for e in read_run(path) if e["kind"] == "tick"]
+    assert ticks == list(range(50))
+    # reopening resumes in the NEWEST part (no shuffle of old parts)
+    before = files[:-1]
+    sizes = [os.path.getsize(f) for f in before]
+    ol2 = RunLog(path, run_id="rr", max_bytes=400)
+    ol2.event("more")
+    ol2.close()
+    assert [os.path.getsize(f) for f in before] == sizes
+    assert [e["kind"] for e in read_run(path)][-1] == "more"
+    # max_bytes=0 disables rotation
+    p2 = str(tmp_path / "norot.jsonl")
+    ol3 = RunLog(p2, run_id="nr", max_bytes=0)
+    for i in range(50):
+        ol3.event("tick", i=i, pad="x" * 40)
+    ol3.close()
+    assert run_files(p2) == [p2]
+
+
 def test_read_events_skips_torn_tail(tmp_path):
     path = str(tmp_path / "torn.jsonl")
     with RunLog(path, run_id="r") as ol:
@@ -341,6 +372,33 @@ def test_bench_single_json_stdout_line(tmp_path, monkeypatch, capsys):
     assert b["value"] == 100.0 and b["run"] == rec["run_id"]
 
 
+def test_bench_records_trace_path(tmp_path, monkeypatch, capsys):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    def fake_run(model="inception", strategy_file=None, compile_cache=False,
+                 **kw):
+        return 100.0, 800.0, 1.0, None, {"windows": 1, "min": 99.0,
+                                         "max": 101.0}
+
+    strat = tmp_path / "s.json"
+    strat.write_text("{}")
+    # a sim trace the search exported next to the strategy rides the line
+    (tmp_path / "s.trace.json").write_text('{"traceEvents": []}')
+    monkeypatch.setattr(bench, "run", fake_run)
+    monkeypatch.setattr(sys, "argv", ["bench.py", str(strat)])
+    monkeypatch.setenv("BENCH_OBS_DIR", str(tmp_path / "obs"))
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["trace_path"] == str(tmp_path / "s.trace.json")
+
+
 # ---------------------------------------------------------------------------
 # flags + report CLI
 
@@ -361,6 +419,9 @@ def test_obs_flags_parsed():
     # -chains / -delta ride both parsers (PR 2)
     sopts = s_args(["alexnet", "-chains", "4", "-delta", "check"])
     assert sopts["chains"] == 4 and sopts["delta"] == "check"
+    sopts = s_args(["alexnet", "-trace"])
+    assert sopts["trace"] is True
+    assert s_args(["alexnet"])["trace"] is False
     cfg = FFConfig.from_args(["-chains", "8", "-delta", "off"])
     assert cfg.search_chains == 8 and cfg.search_delta == "off"
     with pytest.raises(SystemExit):

@@ -1,0 +1,302 @@
+"""Per-op timeline tracing tests (obs/trace.py + ffsim_simulate_trace):
+trace_event schema round-trip against the native simulator, the schema
+validator's teeth, the drift-attribution join, the ``report trace``
+subcommand, ``report --json``, and ``calibrate --from-obs`` anchoring.
+Tier-1: CPU, 8-device virtual mesh, no slow marker."""
+
+import json
+import os
+
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.obs import RunLog
+from flexflow_tpu.obs import trace as obstrace
+
+
+def _small_model(machine, cfg):
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((16, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def _searcher(machine8, obs=None):
+    from flexflow_tpu.sim.search import StrategySearch
+
+    cfg = FFConfig(batch_size=16, input_height=16, input_width=16,
+                   num_classes=8)
+    return StrategySearch(_small_model(machine8, cfg), machine8, obs=obs)
+
+
+# ---------------------------------------------------------------------------
+# simulated timelines (ffsim_simulate_trace)
+
+
+@pytest.mark.native
+def test_simulate_trace_matches_simulate_and_validates(machine8):
+    ss = _searcher(machine8)
+    dp = ss.dp_assignment()
+    tr = ss.simulate_trace(dp)
+    # the exported schedule prices EXACTLY what simulate() prices
+    assert abs(tr["total_s"] - ss.simulate(dp)) < 1e-15
+    names = {e["op"] for e in tr["events"] if e["kind"] == "compute"}
+    assert {"conv1", "flat", "fc", "softmax"} <= names
+    assert all(e["dur"] >= 0 and e["start"] >= 0 for e in tr["events"])
+    # per-op join keys: every real op, per-shard seconds positive
+    assert set(tr["op_s"]) == {"conv1", "flat", "fc", "softmax"}
+    assert all(v > 0 for v in tr["op_s"].values())
+    # chrome trace validates and survives the JSON round trip Perfetto
+    # will perform (required keys, non-negative durs, monotone per-device
+    # compute intervals)
+    trace = obstrace.chrome_trace(
+        obstrace.sim_trace_events(tr, label="sim:test"))
+    assert obstrace.validate_trace(trace) == []
+    assert obstrace.validate_trace(json.loads(json.dumps(trace))) == []
+
+
+@pytest.mark.native
+def test_simulate_trace_searched_assignment(machine8, tmp_path):
+    """The -trace writer: best + dp lanes in one file, sim_trace obs
+    record with the per-op seconds."""
+    from flexflow_tpu.apps.search import _write_sim_trace
+    from flexflow_tpu.obs import read_events
+
+    ol = RunLog(str(tmp_path / "s.jsonl"), run_id="st", surface="search")
+    ss = _searcher(machine8, obs=ol)
+    _, info = ss.search(iters=500, seed=5)
+    opts = {"out": str(tmp_path / "s.json"), "obs_dir": "",
+            "model": "tiny"}
+    path = _write_sim_trace(opts, ss, info, ol, log=lambda *a: None)
+    ol.close()
+    assert path == str(tmp_path / "s.trace.json")
+    with open(path) as f:
+        trace = json.load(f)
+    assert obstrace.validate_trace(trace) == []
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert pids == {obstrace.PID_SIM_BEST, obstrace.PID_SIM_DP}
+    (rec,) = [e for e in read_events(ol.path)
+              if e["kind"] == "sim_trace"]
+    assert rec["path"] == path
+    assert set(rec["op_s"]) == {"conv1", "flat", "fc", "softmax"}
+    assert rec["total_s"] == info["best_time"]
+
+
+def test_validator_catches_violations():
+    assert obstrace.validate_trace({"nope": 1})
+    assert obstrace.validate_trace(
+        {"traceEvents": [{"ph": "X", "pid": 0}]})  # missing name/tid/ts
+    neg = {"traceEvents": [{"name": "a", "ph": "X", "pid": 0, "tid": 0,
+                            "ts": 0.0, "dur": -1.0}]}
+    assert any("dur" in e for e in obstrace.validate_trace(neg))
+    overlap = {"traceEvents": [
+        {"name": "a", "cat": "compute", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 0.0, "dur": 10.0},
+        {"name": "b", "cat": "compute", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 5.0, "dur": 10.0}]}
+    assert any("overlap" in e for e in obstrace.validate_trace(overlap))
+    # transfer lanes may overlap (concurrent flows into one device)
+    flows = {"traceEvents": [
+        {"name": "a", "cat": "transfer", "ph": "X", "pid": 0, "tid": 1000,
+         "ts": 0.0, "dur": 10.0},
+        {"name": "b", "cat": "transfer", "ph": "X", "pid": 0, "tid": 1000,
+         "ts": 5.0, "dur": 10.0}]}
+    assert obstrace.validate_trace(flows) == []
+
+
+# ---------------------------------------------------------------------------
+# attribution join
+
+
+def test_drift_attribution_ranks_by_abs_drift():
+    sim = {"a": {"seconds": 1.0, "op_kind": "K"}, "b": {"seconds": 2.0},
+           "c": {"seconds": 3.0}, "only_sim": {"seconds": 1.0}}
+    real = {"a": {"seconds": 1.5}, "b": {"seconds": 2.1},
+            "c": {"seconds": 2.0}, "only_real": {"seconds": 9.9}}
+    att = obstrace.drift_attribution(sim, real)
+    # |drift|: c = 1.0, a = 0.5, b = 0.1 — ranked most-drifting first
+    assert [r["op"] for r in att["ops"]] == ["c", "a", "b"]
+    assert att["ops"][0]["drift_s"] == pytest.approx(-1.0)
+    assert att["ops"][1]["ratio"] == pytest.approx(1.5)
+    assert sum(r["share"] for r in att["ops"]) == pytest.approx(1.0)
+    assert att["ops"][0]["op_kind"] is None and \
+        att["ops"][1]["op_kind"] == "K"
+    # one-sided ops are coverage gaps, not zero drift
+    assert att["sim_only"] == ["only_sim"]
+    assert att["real_only"] == ["only_real"]
+    assert att["totals"]["drift_s"] == pytest.approx(-0.4)
+
+
+def _synthetic_run(path, drift_value=2.0):
+    with RunLog(path, run_id="syn") as ol:
+        ol.event("search_breakdown", ops=[
+            {"op": "conv1", "kind": "Conv2D", "compute_s": 0.001,
+             "collective_s": 0.0002},
+            {"op": "fc", "kind": "Linear", "compute_s": 0.002,
+             "collective_s": 0.0}], opt_stream_s=0.0005)
+        for op, k, s in (("conv1", "Conv2D", 0.003),
+                         ("fc", "Linear", 0.002)):
+            ol.event("op_time", scope="op", op=op, op_kind=k, seconds=s,
+                     measured=True)
+        for sec, s in (("forward", 0.004), ("backward", 0.006),
+                       ("optimizer", 0.001), ("step", 0.011)):
+            ol.event("op_time", scope="section", section=sec, step=2,
+                     seconds=s)
+        ol.event("sim_drift", name="sim_drift", value=drift_value,
+                 predicted_s=0.005, measured_s=0.005 * drift_value,
+                 source="artifact")
+
+
+def test_report_trace_subcommand(tmp_path):
+    from flexflow_tpu.apps import report
+
+    path = str(tmp_path / "run.jsonl")
+    _synthetic_run(path)
+    out_dir = str(tmp_path / "out")
+    msgs = []
+    assert report.main(["trace", path, "-o", out_dir],
+                       log=msgs.append) == 0
+    with open(os.path.join(out_dir, "drift_attribution.json")) as f:
+        att = json.load(f)
+    # conv1: sim 0.0012 vs real 0.003 (drift 0.0018); fc: exact match
+    assert [r["op"] for r in att["ops"]] == ["conv1", "fc"]
+    assert att["ops"][0]["drift_s"] == pytest.approx(0.0018)
+    assert att["ops"][1]["drift_s"] == pytest.approx(0.0)
+    assert att["step"]["ratio"] == 2.0
+    with open(os.path.join(out_dir, "merged.trace.json")) as f:
+        merged = json.load(f)
+    assert obstrace.validate_trace(merged) == []
+    # sim lanes AND real lanes present
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert {obstrace.PID_SIM_BEST, obstrace.PID_REAL} <= pids
+    assert any("drift attribution" in m for m in msgs)
+    # --json emits one machine-readable object
+    msgs2 = []
+    assert report.main(["trace", path, "-o", out_dir, "--json"],
+                       log=msgs2.append) == 0
+    obj = json.loads(msgs2[-1])
+    assert obj["attribution"]["ops"][0]["op"] == "conv1"
+
+
+def test_report_json_flag(tmp_path):
+    from flexflow_tpu.apps import report
+
+    path = str(tmp_path / "run.jsonl")
+    _synthetic_run(path)
+    msgs = []
+    assert report.main([path, "--json"], log=msgs.append) == 0
+    (line,) = msgs
+    obj = json.loads(line)  # ONE machine-readable JSON object
+    assert obj["runs"] == ["syn"]
+    assert obj["kinds"]["op_time"] == 6
+    assert obj["sim_drift"]["value"] == 2.0
+    assert obj["op_time"]["ops"]["conv1"]["seconds"] == 0.003
+    assert obj["op_time"]["sections_median_s"]["backward"] == 0.006
+    # prose mode still renders (and mentions the drift gauge)
+    msgs2 = []
+    assert report.main([path], log=msgs2.append) == 0
+    assert "sim_drift" in msgs2[0]
+
+
+# ---------------------------------------------------------------------------
+# calibrate --from-obs: the recalibration loop
+
+
+def test_calibrate_from_obs_moves_anchors(tmp_path):
+    from flexflow_tpu.apps.calibrate import calibrate_from_obs
+    from flexflow_tpu.machine import Topology
+    from flexflow_tpu.sim.cost_model import MeasuredCostModel
+
+    obs_dir = tmp_path / "obs"
+    with RunLog(str(obs_dir / "r.jsonl"), run_id="r") as ol:
+        ol.event("search_breakdown", ops=[
+            {"op": "conv1", "kind": "Conv2D", "compute_s": 0.001,
+             "collective_s": 0.001}], opt_stream_s=0.0)
+        # measured op runs 2x the simulated compute -> anchor moves to 2
+        ol.event("op_time", scope="op", op="conv1", op_kind="Conv2D",
+                 seconds=0.002, measured=True)
+        ol.event("sim_drift", name="sim_drift", value=3.0,
+                 predicted_s=0.002, measured_s=0.006, source="artifact")
+    out = str(tmp_path / "cal.json")
+    payload = calibrate_from_obs(str(obs_dir), out, log=lambda *a: None)
+    assert payload["kind_anchors"]["Conv2D"] == pytest.approx(2.0)
+    # residual: measured 0.006 - anchored compute 0.002 = 0.004 over
+    # 0.001 simulated collective seconds -> DCN constants scale 4x
+    assert payload["collective_scale"] == pytest.approx(4.0)
+    assert payload["sim_drift"]["median_ratio"] == 3.0
+    # the artifact feeds BOTH existing knob families directly
+    topo = Topology.from_calibration(out)
+    assert topo.dcn_bandwidth == \
+        pytest.approx(Topology().dcn_bandwidth / 4.0)
+    assert topo.dcn_latency == pytest.approx(Topology().dcn_latency * 4.0)
+    mcm = MeasuredCostModel(anchors_path=out)
+    assert mcm._kind_ratios["Conv2D"] == [2.0]
+    # in-memory seeding takes precedence over the artifact
+    mcm2 = MeasuredCostModel(anchors_path=out,
+                             anchors={"Conv2D": 1.5})
+    assert mcm2._kind_ratios["Conv2D"] == [1.5]
+
+
+def test_calibrate_from_obs_empty_dir(tmp_path):
+    from flexflow_tpu.apps.calibrate import calibrate_from_obs
+
+    msgs = []
+    payload = calibrate_from_obs(str(tmp_path), log=msgs.append)
+    assert payload["kind_anchors"] == {}
+    assert payload["collective_scale"] is None
+    assert any("no op_time/sim_drift records" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# fit's measured side (op_time records)
+
+
+def test_fit_op_time_records(tmp_path, machine8):
+    from flexflow_tpu.data import synthetic_batches
+    from flexflow_tpu.obs import read_run
+
+    cfg = FFConfig(batch_size=8, input_height=16, input_width=16,
+                   num_iterations=4, print_freq=0, num_classes=8,
+                   obs_dir=str(tmp_path), run_id="optime",
+                   op_time_every=2)
+    ff = FFModel(cfg, machine8)
+    img = ff.create_input((8, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    data = synthetic_batches(machine8, 8, 16, 16, num_classes=8,
+                             mode="ones")
+    out = ff.fit(data, num_iterations=4, log=lambda *a: None)
+    evs = list(read_run(out["obs_path"]))
+    sections = [e for e in evs if e["kind"] == "op_time"
+                and e["scope"] == "section"]
+    per_op = [e for e in evs if e["kind"] == "op_time"
+              and e["scope"] == "op"]
+    # steps 2 and 4 sampled, four sections each
+    assert sorted({e["step"] for e in sections}) == [2, 4]
+    assert [e["section"] for e in sections[:4]] == \
+        ["forward", "backward", "optimizer", "step"]
+    assert all(e["seconds"] >= 0 for e in sections)
+    # one isolated shard timing per layer, join-keyed by op name
+    assert [e["op"] for e in per_op] == ["conv1", "flat", "fc",
+                                         "softmax"]
+    assert all(e["seconds"] > 0 for e in per_op)
+    # the gauge's absence is explained, not silent (no strategy loaded)
+    (un,) = [e for e in evs if e["kind"] == "sim_drift_unavailable"]
+    assert "no strategy" in un["reason"]
+    # and losses/steps are untouched by the sampling mode
+    assert len([e for e in evs if e["kind"] == "step"]) == 4
+    assert all(isinstance(l, float) for l in out["loss"])
+
+
+def test_op_time_flags_parsed():
+    cfg = FFConfig.from_args(["--op-time-every", "5",
+                              "--obs-max-bytes", "1234"])
+    assert cfg.op_time_every == 5 and cfg.obs_max_bytes == 1234
+    cfg = FFConfig.from_args(["-op-time-every", "3"])
+    assert cfg.op_time_every == 3
