@@ -1,7 +1,7 @@
 # Repo-level entry points; the native build lives in flexflow_tpu/native.
 PYTHON ?= python
 
-.PHONY: native check trace-smoke test
+.PHONY: native check trace-smoke test bench-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -19,3 +19,14 @@ trace-smoke:
 # the tier-1 test selection (CPU, 8-device virtual mesh)
 test:
 	$(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+# tiny-config bench on the local backend asserting the metric line
+# carries the round-6 execution-performance fields (regrid planner hop
+# count + prefetch stall residual) — schema smoke, not a perf number
+bench-smoke:
+	BENCH_MODEL=alexnet BENCH_BATCH=16 BENCH_ITERS=2 BENCH_WARMUP=1 \
+	BENCH_WINDOWS=1 BENCH_DTYPE=float32 $(PYTHON) bench.py \
+	| $(PYTHON) -c "import json,sys; rec=json.loads(sys.stdin.readline()); \
+	assert 'regrid_hops' in rec and 'input_stall_s' in rec, rec; \
+	print('bench-smoke ok:', {k: rec[k] for k in \
+	('value','regrid_hops','input_stall_s')})"

@@ -77,6 +77,10 @@ def parse_args(argv) -> TransformerConfig:
             cfg.obs_dir = val()
         elif a in ("-run-id", "--run-id"):
             cfg.run_id = val()
+        elif a in ("-regrid-planner", "--regrid-planner"):
+            cfg.regrid_planner = val()
+        elif a in ("-prefetch-depth", "--prefetch-depth"):
+            cfg.prefetch_depth = int(val())
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg
@@ -103,12 +107,15 @@ def _per_op_tp(strategies, cfg) -> int:
     be misread as head TP).  Accepted when it divides the model's heads
     and d_ff and every attention entry agrees; otherwise 1 (pure
     PP x DP, the round-4 behavior)."""
+    # EVERY rank-3 attention entry votes, including unsplit ones — a file
+    # mixing split and unsplit attention grids is ambiguous and must not
+    # silently derive tp from the split subset (round-6 ADVICE)
     splits = {pc.dims[1] for name, pc in strategies.items()
-              if "attn" in name and len(pc.dims) == 3 and pc.dims[1] > 1}
+              if "attn" in name and len(pc.dims) == 3}
     if len(splits) != 1:
         return 1
     tp = splits.pop()
-    if cfg.num_heads % tp or cfg.d_ff % tp:
+    if tp <= 1 or cfg.num_heads % tp or cfg.d_ff % tp:
         return 1
     return tp
 

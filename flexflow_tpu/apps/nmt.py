@@ -68,6 +68,10 @@ def parse_args(argv) -> RnnConfig:
             cfg.obs_dir = val()
         elif a in ("-run-id", "--run-id"):
             cfg.run_id = val()
+        elif a in ("-regrid-planner", "--regrid-planner"):
+            cfg.regrid_planner = val()
+        elif a in ("-prefetch-depth", "--prefetch-depth"):
+            cfg.prefetch_depth = int(val())
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg

@@ -65,6 +65,16 @@ class FFConfig:
     # full, aborting on divergence; debug only)
     search_chains: int = 1
     search_delta: str = "on"
+    # execution performance (round 6): the whole-graph regrid planner
+    # (parallel/regrid.py) — "on" (default) resolves every
+    # producer->consumer reshard once at plan time with coalescing and
+    # cost-aware hop selection; "off" keeps the legacy per-trace path
+    # (loss-bit-identical — the equivalence tests compare the two).
+    regrid_planner: str = "on"
+    # double-buffered device prefetch (data/prefetch.py): queue depth of
+    # batches staged on device ahead of the training loop; 0 disables
+    # (the legacy synchronous pull inside the timed loop)
+    prefetch_depth: int = 2
 
     strategies: Strategy = dataclasses.field(default_factory=Strategy)
 
@@ -133,6 +143,10 @@ class FFConfig:
                 cfg.search_chains = int(val())
             elif a in ("-delta", "--delta"):
                 cfg.search_delta = val()
+            elif a in ("-regrid-planner", "--regrid-planner"):
+                cfg.regrid_planner = val()
+            elif a in ("-prefetch-depth", "--prefetch-depth"):
+                cfg.prefetch_depth = int(val())
             elif a == "--ckpt-dir":
                 cfg.ckpt_dir = val()
             elif a == "--ckpt-freq":

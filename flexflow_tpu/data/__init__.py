@@ -17,6 +17,7 @@ from flexflow_tpu.data.synthetic import (synthetic_batches,
                                           synthetic_token_stream)
 from flexflow_tpu.data.imagenet import ImageDataset, image_batches
 from flexflow_tpu.data.hdf5 import hdf5_batches
+from flexflow_tpu.data.prefetch import DevicePrefetcher, prefetch_batches
 
 __all__ = [
     "synthetic_batches",
@@ -24,4 +25,6 @@ __all__ = [
     "ImageDataset",
     "image_batches",
     "hdf5_batches",
+    "DevicePrefetcher",
+    "prefetch_batches",
 ]

@@ -56,9 +56,11 @@ def _normalize(img: np.ndarray) -> np.ndarray:
 
 
 def hdf5_batches(machine, paths: List[str], batch_size: int,
-                 prefetch: int = 2) -> Iterator[Tuple]:
+                 prefetch: int = 2, place: bool = True) -> Iterator[Tuple]:
     """Yield (images, labels) forever from HDF5 batch files, prefetching on
-    a background thread."""
+    a background thread.  ``place=False`` yields host numpy batches and
+    leaves the sharded ``device_put`` to the caller's DevicePrefetcher
+    (data/prefetch.py) so H2D staging overlaps compute."""
     import h5py
     import jax
 
@@ -66,7 +68,7 @@ def hdf5_batches(machine, paths: List[str], batch_size: int,
 
     if not paths:
         raise ValueError("hdf5_batches needs at least one file")
-    sharding = _batch_sharding(machine)
+    sharding = _batch_sharding(machine) if place else None
     files = [h5py.File(p, "r") for p in paths]
     positions = [0] * len(files)
 
@@ -109,8 +111,11 @@ def hdf5_batches(machine, paths: List[str], batch_size: int,
             if isinstance(item, _ProducerError):
                 raise RuntimeError("hdf5 prefetch thread failed") from item.exc
             img, lbl = item
-            yield (jax.device_put(img, sharding),
-                   jax.device_put(lbl, sharding))
+            if sharding is None:
+                yield img, lbl
+            else:
+                yield (jax.device_put(img, sharding),
+                       jax.device_put(lbl, sharding))
     finally:
         stop.set()
         t.join(timeout=2.0)

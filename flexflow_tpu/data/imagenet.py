@@ -107,16 +107,29 @@ def decode_batch_pil(files: List[str], height: int,
 def image_batches(machine, dataset: ImageDataset, batch_size: int,
                   height: int, width: int, num_threads: int = 4,
                   prefetch: int = 2, shuffle_seed: Optional[int] = 0,
-                  use_native: bool = True) -> Iterator[Tuple]:
+                  use_native: bool = True,
+                  place: bool = True) -> Iterator[Tuple]:
     """Yield (images NHWC float32 sharded, labels int32 sharded) forever,
-    with `prefetch` batches of JPEG decode in flight."""
+    with `prefetch` batches of JPEG decode in flight.
+
+    ``place=False`` yields HOST numpy batches instead of committing them —
+    the caller's :class:`~flexflow_tpu.data.prefetch.DevicePrefetcher`
+    (fit() wraps every source with one) then does the sharded
+    ``device_put`` on its staging thread, overlapping H2D with the
+    previous step's compute instead of paying it here."""
     import jax
 
     from flexflow_tpu.data.synthetic import _batch_sharding
 
     if shuffle_seed is not None:
         dataset.shuffle_samples(shuffle_seed)
-    sharding = _batch_sharding(machine)
+    sharding = _batch_sharding(machine) if place else None
+
+    def commit(img, lbl):
+        if sharding is None:
+            return img, np.asarray(lbl, np.int32)
+        return (jax.device_put(img, sharding),
+                jax.device_put(np.asarray(lbl, np.int32), sharding))
 
     loader = None
     if use_native:
@@ -135,11 +148,9 @@ def image_batches(machine, dataset: ImageDataset, batch_size: int,
             img, lbl = loader.next()
             lbls, files = dataset.get_samples(batch_size)
             loader.submit(files, lbls)  # keep the pipeline full
-            yield (jax.device_put(img, sharding),
-                   jax.device_put(lbl, sharding))
+            yield commit(img, lbl)
     else:
         while True:
             lbls, files = dataset.get_samples(batch_size)
             img = decode_batch_pil(files, height, width)
-            yield (jax.device_put(img, sharding),
-                   jax.device_put(np.asarray(lbls, np.int32), sharding))
+            yield commit(img, lbls)

@@ -307,6 +307,12 @@ class MachineModel:
     # the GSPMD analog of the reference's implicit repartitioning between
     # differently-gridded producers/consumers (conv_2d.cu:171-208).
 
+    def global_factors(self):
+        """[(axis_name, prime_size), ...] of the global factored mesh —
+        the public accessor the regrid planner (parallel/regrid.py)
+        prices hops against."""
+        return list(self._global_factors())
+
     def _global_factors(self):
         """[(axis_name, prime_size), ...] — ascending prime factorization
         of the machine size, cached."""
@@ -406,7 +412,14 @@ class MachineModel:
         efficiently where it would full-rematerialize the combined jump.
         Returns the intermediate entry tuples (excluding ``dst`` itself),
         or None when the greedy ordering cannot reach ``dst`` (caller then
-        constrains directly — the status quo)."""
+        constrains directly — the status quo).
+
+        This is the GREEDY decomposition (drops first, then moves in
+        destination order) — the legacy per-trace path and the regrid
+        planner's pricing baseline.  Planned execution
+        (parallel/regrid.py, the round-6 default) instead picks the
+        cheapest hop sequence under the topology's link costs and can
+        reach order inversions this greedy returns None for."""
         if len(src) != len(dst):
             return None
         if src == dst:

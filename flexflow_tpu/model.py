@@ -847,6 +847,38 @@ class FFModel:
             schedule = range(len(self.layers))
         return fusion, schedule
 
+    def _regrid_plan_for(self, fusion, schedule):
+        """The whole-graph :class:`~flexflow_tpu.parallel.regrid.RegridPlan`
+        for this (fusion, schedule) — every producer->consumer reshard
+        edge resolved, coalesced, and cost-priced ONCE instead of
+        re-derived per input per op on every trace (parallel/regrid.py).
+        Cached per fusion-exclusion set; None on single-device machines,
+        in dump mode, or when ``config.regrid_planner`` is "off" (the
+        legacy per-trace path, kept for the bit-identical equivalence
+        tests)."""
+        if self.machine.num_devices <= 1 or self.config.print_intermediates:
+            return None
+        if getattr(self.config, "regrid_planner", "on") == "off":
+            return None
+        key = frozenset(fusion)
+        cache = getattr(self, "_regrid_plans", None)
+        if cache is None:
+            cache = self._regrid_plans = {}
+        if key not in cache:
+            from flexflow_tpu.parallel.regrid import build_regrid_plan
+
+            cache[key] = build_regrid_plan(self, fusion, schedule)
+        return cache[key]
+
+    def regrid_plan_summary(self, train: bool = True):
+        """The active regrid plan's accounting (edges / hops / sharding
+        constraints before vs after coalescing, predicted transfer cost
+        and bytes) — the ``regrid_plan`` obs record body; None when the
+        planner is inactive."""
+        fusion, schedule = self._plan(train)
+        plan = self._regrid_plan_for(fusion, schedule)
+        return plan.summary() if plan is not None else None
+
     def apply(self, params, state, inputs: Dict[int, Any], train: bool):
         """Run the DAG. ``inputs`` maps input-Tensor tid -> array.
         Returns (tensor-values dict, new_state)."""
@@ -868,14 +900,21 @@ class FFModel:
         multi = self.machine.num_devices > 1
         dump = self.config.print_intermediates
         fusion, schedule = self._plan(train)
+        # planned regrids (parallel/regrid.py): every reshard edge was
+        # resolved once at plan time; _apply only looks plans up by
+        # (op name, input index) and reuses fan-out reshards via rcache.
+        # plan None -> the legacy per-trace path below re-derives edges.
+        plan = self._regrid_plan_for(fusion, schedule)
+        rcache: Dict[Any, Any] = {}
         values: Dict[int, Any] = dict(inputs)
         new_state: Dict[str, Dict] = {}
         # tid -> global-mesh entry tuple of each produced value, for
         # decomposing producer->consumer regrids (see _regrid_inputs);
         # model inputs arrive batch-sharded over the whole machine (the
-        # loaders' convention, data/synthetic.py)
+        # loaders' convention, data/synthetic.py).  Only tracked on the
+        # legacy path — the planner mirrored it at plan time.
         specs: Dict[int, Any] = {}
-        if multi:
+        if multi and plan is None:
             dp = ParallelConfig.data_parallel(1, self.machine.num_devices)
             from jax.sharding import PartitionSpec as P
 
@@ -894,16 +933,24 @@ class FFModel:
                                         entry, j, g)
                         for j, (m, g) in
                         enumerate(zip(entry.members, entry.slots))]
+                if plan is not None:
+                    member_inputs = [
+                        [plan.apply(m.name, i, values[t.tid], rcache)
+                         for i, t in enumerate(m.inputs)]
+                        for m in entry.members]
+                else:
+                    member_inputs = [
+                        self._regrid_group_inputs(
+                            entry, m, [values[t.tid] for t in m.inputs],
+                            specs) if multi else
+                        [values[t.tid] for t in m.inputs]
+                        for m in entry.members]
                 outs_by_member, states_by_member = run_group(
                     self.machine, entry,
                     [params.get(m.param_key, {}) if pre[j] else
                      self._member_params(params, m)
                      for j, m in enumerate(entry.members)],
-                    [self._regrid_group_inputs(
-                        entry, m, [values[t.tid] for t in m.inputs],
-                        specs) if multi else
-                     [values[t.tid] for t in m.inputs]
-                     for m in entry.members], train,
+                    member_inputs, train,
                     [state.get(m.name, {}) if spre[j] else
                      self._member_state(state, m)
                      for j, m in enumerate(entry.members)],
@@ -920,7 +967,7 @@ class FFModel:
                         # _regrid_inputs can decompose the jump into
                         # single-axis hops instead of letting GSPMD
                         # full-rematerialize it (round 5)
-                        if multi and spec is not None:
+                        if multi and plan is None and spec is not None:
                             specs[t.tid] = self.machine.global_entries(
                                 m.pc, m.AXIS_NAMES, spec, rank=t.ndim)
                     if st:
@@ -938,7 +985,10 @@ class FFModel:
                     values[op.labels_tensor.tid])
                 continue
             xs = [values[t.tid] for t in op.inputs]
-            if multi:
+            if multi and plan is not None:
+                xs = [plan.apply(op.name, i, x, rcache)
+                      for i, x in enumerate(xs)]
+            elif multi:
                 xs = self._regrid_inputs(op, xs, specs)
             res, st = op.forward(self._member_params(params, op),
                                  self._member_state(state, op), xs, train)
@@ -949,8 +999,9 @@ class FFModel:
                 if multi and spec is not None:
                     y = lax.with_sharding_constraint(
                         y, self.machine.sharding(op.pc, op.AXIS_NAMES, spec))
-                    specs[t.tid] = self.machine.global_entries(
-                        op.pc, op.AXIS_NAMES, spec, rank=t.ndim)
+                    if plan is None:
+                        specs[t.tid] = self.machine.global_entries(
+                            op.pc, op.AXIS_NAMES, spec, rank=t.ndim)
                 if dump:
                     print_tensor(f"{op.name}/{t.name or 'out'}", y)
                 values[t.tid] = y
@@ -959,11 +1010,13 @@ class FFModel:
         return values, new_state
 
     def _regrid_group_inputs(self, entry, m, xs, specs):
-        """Decomposed resharding for a placement-group member's inputs
-        (round 5).  Group inputs bypass ``_regrid_inputs`` and meet the
-        group shard_map's in_specs directly; when the producer's layout
-        is known on the global mesh, walk there in single-axis hops
-        exactly like the single-op path — a spatial-grid producer
+        """LEGACY per-trace resharding for a placement-group member's
+        inputs (round 5) — only reached with ``regrid_planner=off``; the
+        planned path applies the pre-resolved ``RegridPlan`` edges in
+        ``_apply`` instead.  Group inputs bypass ``_regrid_inputs`` and
+        meet the group shard_map's in_specs directly; when the producer's
+        layout is known on the global mesh, walk there in single-axis
+        hops exactly like the single-op path — a spatial-grid producer
         feeding a batch-grid group otherwise triggers GSPMD's
         involuntary full rematerialization at the shard_map boundary.
         Set-family members consume REPLICATED operands (the per-device
@@ -995,13 +1048,15 @@ class FFModel:
         return out
 
     def _regrid_inputs(self, op, xs, specs):
-        """Re-shard ``op``'s inputs to the layout its compute wants, as a
-        chain of single-mesh-axis hops (MachineModel.regrid_steps) from each
-        producer's recorded layout.  GSPMD lowers each hop as an
-        all-to-all / all-gather / slice where the combined jump would
-        trigger involuntary full rematerialization.  The reference relies on
-        Legion for the same producer/consumer repartitioning
-        (conv_2d.cu:171-208)."""
+        """LEGACY per-trace resharding of ``op``'s inputs to the layout
+        its compute wants, as a chain of single-mesh-axis hops
+        (MachineModel.regrid_steps) from each producer's recorded layout
+        — only reached with ``regrid_planner=off``; the planned path
+        applies pre-resolved ``RegridPlan`` edges in ``_apply``.  GSPMD
+        lowers each hop as an all-to-all / all-gather / slice where the
+        combined jump would trigger involuntary full rematerialization.
+        The reference relies on Legion for the same producer/consumer
+        repartitioning (conv_2d.cu:171-208)."""
         from jax import lax
 
         want = op.regrid_input_specs()
@@ -1234,6 +1289,19 @@ class FFModel:
         if not resumed:
             params, state = self.init()
             opt_state = self.init_opt_state(params)
+        # double-buffered device prefetch (data/prefetch.py): host batch
+        # prep + sharded H2D of step N+1 overlap step N's compute instead
+        # of running synchronously inside the timed loop.  Wrapped AFTER
+        # the resume skip so a deterministic stream stays aligned;
+        # prefetch_depth=0 disables (the legacy synchronous pull).
+        prefetcher = None
+        _depth = max(int(getattr(self.config, "prefetch_depth", 2) or 0), 0)
+        if _depth:
+            from flexflow_tpu.data.prefetch import DevicePrefetcher
+
+            prefetcher = DevicePrefetcher(data_iter, machine=self.machine,
+                                          depth=_depth)
+            data_iter = iter(prefetcher)
         step = self.make_train_step()
         warmup = start_iter + min(warmup,
                                   max(num_iterations - start_iter - 1, 0))
@@ -1300,6 +1368,10 @@ class FFModel:
             if loss is not None:
                 float(loss)
             elapsed = time.perf_counter() - start
+        if prefetcher is not None:
+            # stop the staging thread before post-loop work; an
+            # exceptional exit closes it via DevicePrefetcher.__del__
+            prefetcher.close()
         if ckpt_dir and start_iter < num_iterations:
             t0 = time.perf_counter()
             ckpt.save_checkpoint(ckpt_dir, num_iterations, params, state,
@@ -1318,6 +1390,17 @@ class FFModel:
                                    step, params, state, opt_state,
                                    batch if losses else None, op_samples,
                                    sample_every)
+            # execution-performance records (round 6): the regrid plan's
+            # coalescing accounting and the prefetch stall residual —
+            # both strictly post-loop, like every other fit record
+            try:
+                rsum = self.regrid_plan_summary()
+            except Exception:
+                rsum = None
+            if rsum:
+                olog.event("regrid_plan", **rsum)
+            if prefetcher is not None:
+                olog.event("prefetch", **prefetcher.summary())
         if self.config.profiling:
             # Flag-gated profiling report (reference: per-task cudaEvent ms
             # when `profiling` is set, conv_2d.cu:514-545).  Lead with the
@@ -1348,6 +1431,7 @@ class FFModel:
             "params": params, "state": state,
             "loss": losses,
             "elapsed_s": elapsed, "images_per_sec": throughput,
+            "input_stall_s": prefetcher.stall_s if prefetcher else 0.0,
             "run_id": olog.run_id, "obs_path": olog.path,
         }
 

@@ -48,6 +48,9 @@ class TransformerConfig:
     # run telemetry (forwarded to FFConfig; obs subsystem)
     obs_dir: str = ""
     run_id: str = ""
+    # execution performance (forwarded to FFConfig; round 6)
+    regrid_planner: str = "on"
+    prefetch_depth: int = 2
 
 
 class TransformerLM(FFModel):
@@ -71,6 +74,8 @@ class TransformerLM(FFModel):
             dry_compile=self.t.dry_compile,
             obs_dir=self.t.obs_dir,
             run_id=self.t.run_id,
+            regrid_planner=self.t.regrid_planner,
+            prefetch_depth=self.t.prefetch_depth,
             strategies=strategies or Strategy(),
         )
         super().__init__(ff_cfg, machine)

@@ -59,6 +59,9 @@ class RnnConfig:
     # run telemetry (forwarded to FFConfig; obs subsystem)
     obs_dir: str = ""
     run_id: str = ""
+    # execution performance (forwarded to FFConfig; round 6)
+    regrid_planner: str = "on"
+    prefetch_depth: int = 2
 
     @property
     def chunks_per_seq(self) -> int:
@@ -142,6 +145,8 @@ class RnnModel(FFModel):
             dry_compile=self.rnn.dry_compile,
             obs_dir=self.rnn.obs_dir,
             run_id=self.rnn.run_id,
+            regrid_planner=self.rnn.regrid_planner,
+            prefetch_depth=self.rnn.prefetch_depth,
             strategies=strategies,
         )
         super().__init__(ff_cfg, machine)

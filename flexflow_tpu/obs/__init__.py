@@ -17,7 +17,10 @@ fished out of mixed stdout.  This package gives them ONE record schema:
     ``checkpoint_restore`` / ``sim_drift`` (training, model.py::fit),
     ``search_space`` / ``search_chunk`` / ``search_result`` /
     ``search_breakdown`` / ``pipeline_candidate`` / ``pipeline_decision``
-    (sim/search.py), and ``hlo_audit`` / ``bench`` (audit/bench);
+    (sim/search.py), ``hlo_audit`` / ``bench`` (audit/bench), and the
+    execution-performance pair (round 6) — ``regrid_plan`` (the regrid
+    planner's coalescing/hop accounting, parallel/regrid.py) and
+    ``prefetch`` (device-prefetch stall residual, data/prefetch.py);
   * :class:`RunLog` is the thread-safe sink; :class:`NullRunLog` (the
     module-level ``NULL``) is the disabled sink whose every method is a
     no-op, so instrumented code pays one predicate/attribute check when

@@ -133,6 +133,21 @@ def _fit_section(events: List[Dict]) -> List[str]:
         # reads as "no drift", which is exactly wrong
         lines.append("  sim_drift unavailable: "
                      f"{u.get('reason') or u.get('error') or '?'}")
+    # execution-performance records (round 6)
+    for r in (e for e in events if e.get("kind") == "regrid_plan"):
+        lines.append(
+            f"  regrid plan: {r.get('edges', 0)} edges "
+            f"({r.get('noop_edges', 0)} coalesced no-ops, "
+            f"{r.get('shared_edges', 0)} fan-out shared), "
+            f"constraints {r.get('constraints_before', 0)} -> "
+            f"{r.get('constraints_after', 0)}, predicted transfer "
+            f"{_fmt_s(r.get('predicted_transfer_s', 0.0))} "
+            f"(greedy {_fmt_s(r.get('greedy_transfer_s', 0.0))})")
+    for p in (e for e in events if e.get("kind") == "prefetch"):
+        lines.append(
+            f"  prefetch: depth {p.get('depth', '?')}, "
+            f"{p.get('batches', 0)} batches, input stall "
+            f"{_fmt_s(p.get('input_stall_s', 0.0))}")
     return lines
 
 

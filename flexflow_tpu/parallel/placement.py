@@ -85,7 +85,8 @@ class PlacementGroup:
     owner_axes: Optional[Tuple[str, ...]] = None
 
 
-def placement_slot(op: Op, num_devices: int):
+def placement_slot(op: Op, num_devices: int,
+                   pc: Optional["ParallelConfig"] = None):
     """("block", g) when ``op``'s ParallelConfig names the contiguous
     device block ``[g*P, (g+1)*P)``; ("stride", b) when it names the
     constant-stride set ``{b + j*(N/P)}`` (VERDICT r2 #3b, e.g.
@@ -95,8 +96,14 @@ def placement_slot(op: Op, num_devices: int):
     reference's RnnMapper pins a task to any named GPU,
     nmt/rnn_mapper.cc:131-135).  None when the op cannot run placed
     (no placed support for this grid, duplicates, or a grid that does
-    not divide the machine) — those normalize with a warning."""
-    pc = op.pc
+    not divide the machine) — those normalize with a warning.
+
+    ``pc`` overrides the op's own config — the simulator asks whether a
+    CANDIDATE grid/device list would lower as a placement group (the
+    dispatch-overhead gate, sim/collectives.py) without mutating the
+    op."""
+    if pc is None:
+        pc = op.pc
     p = pc.num_parts
     if num_devices <= 1 or p > num_devices:
         return None
@@ -109,7 +116,7 @@ def placement_slot(op: Op, num_devices: int):
         # canonical full-machine list: the normal (free) GSPMD path —
         # never a placement group
         return None
-    if op.input_specs() is None or \
+    if op.input_specs(pc) is None or \
             (op.init_state() and op.state_specs() is None):
         # block/stride execution impossible (no placed specs for this
         # grid, or stateful without placed-state support) — but
@@ -117,19 +124,19 @@ def placement_slot(op: Op, num_devices: int):
         # overriding point_forward slices its own windows from the FULL
         # replicated operands and needs neither (round 5, e.g. a
         # stride-2 spatial conv on ANY duplicate-free device list)
-        return ("set", tuple(pc.devices)) if _set_eligible(op) else None
+        return ("set", tuple(pc.devices)) if _set_eligible(op, pc) else None
     if num_devices % p:
         # block/stride tilings need P | N; set-family per-device dispatch
         # does not (its flat mesh just leaves more devices on the zero
         # branch), so e.g. a (1,3) grid on (0,3,5) of 8 is still honored
-        return ("set", tuple(pc.devices)) if _set_eligible(op) else None
+        return ("set", tuple(pc.devices)) if _set_eligible(op, pc) else None
     if p == num_devices:
         # non-canonical full-machine list (the canonical order returned
         # above): a single foreign permutation is absorbed by the
         # machine-view rebuild (model._permuted_machine_view) before ops
         # are built, so reaching here means CONFLICTING permutations —
         # honor each via per-device dispatch (resharding at entry/exit)
-        return ("set", tuple(pc.devices)) if _set_eligible(op) else None
+        return ("set", tuple(pc.devices)) if _set_eligible(op, pc) else None
     # block/stride detection is order-insensitive: a strict-subset grid is
     # placement-symmetric (which grid point lands on which member device
     # permutes shard routing only), so the device SET decides the family —
@@ -143,10 +150,10 @@ def placement_slot(op: Op, num_devices: int):
     s = num_devices // p
     if d0 < s and devs == tuple(d0 + j * s for j in range(p)):
         return ("stride", d0)
-    return ("set", tuple(pc.devices)) if _set_eligible(op) else None
+    return ("set", tuple(pc.devices)) if _set_eligible(op, pc) else None
 
 
-def _set_eligible(op: Op) -> bool:
+def _set_eligible(op: Op, pc: Optional["ParallelConfig"] = None) -> bool:
     """Can ``op`` run under set-family per-device dispatch?  The runner
     computes each grid point from the FULL (replicated) operands via
     ``Op.point_forward``: the op must declare point capability
@@ -159,13 +166,15 @@ def _set_eligible(op: Op) -> bool:
     collectives).  Ops on the default ``point_forward`` additionally
     need sliceable input and param specs (the default slices by spec;
     overriders slice their own windows)."""
+    if pc is None:
+        pc = op.pc
     if not op.point_placeable():
         return False
     if op.init_state() and (
             op.state_specs() is None
             or type(op).point_forward is Op.point_forward):
         return False
-    sizes = dict(zip(op.AXIS_NAMES, op.pc.dims))
+    sizes = dict(zip(op.AXIS_NAMES, pc.dims))
 
     def ok(spec, shape):
         # single-axis entries only, and every sharded dim must divide
@@ -196,9 +205,9 @@ def _set_eligible(op: Op) -> bool:
         if not all(ok(params[k], shapes[k].shape) for k in params):
             return False  # param point-slicing is shared by both paths
     if type(op).point_forward is Op.point_forward:
-        if op.input_specs() is None or not all(
+        if op.input_specs(pc) is None or not all(
                 ok(s, t.shape)
-                for s, t in zip(op.input_specs(), op.inputs)):
+                for s, t in zip(op.input_specs(pc), op.inputs)):
             return False
     return True
 

@@ -163,9 +163,21 @@ def dispatch_overhead_cost(op: Op, pc: ParallelConfig, topo: Topology,
     Model: one hierarchical broadcast of the inputs + one of the
     outputs per step (an all-gather is half an all-reduce), doubled for
     the backward transposes (reduce of the broadcast, scatter of the
-    stack)."""
+    stack).
+
+    Gated on the SAME eligibility the executor applies
+    (parallel/placement.py placement_slot): a config the executor
+    rejects (duplicate ids, a non-placeable op, p > N, ...) silently
+    normalizes onto the canonical order and never lowers as a placement
+    group — it pays no entry/exit broadcast, so the simulator must not
+    charge one (round-6 ADVICE: the ungated overhead over-priced
+    exactly the configs the executor runs for free)."""
     if pc.devices == tuple(range(n_devices)):
         return 0.0   # canonical full machine: no placement group
+    from flexflow_tpu.parallel.placement import placement_slot
+
+    if placement_slot(op, n_devices, pc) is None:
+        return 0.0   # executor normalizes this config: no group lowering
     all_devs = tuple(range(n_devices))
     in_bytes = BYTES * sum(t.size() for t in op.inputs)
     out_bytes = BYTES * sum(t.size() for t in op.all_outputs())

@@ -35,6 +35,17 @@ class TpuChipPerf:
 _MATMUL_OPS = {"Conv2D", "Linear", "LSTMChunk", "RnnLinear",
                "MixtureOfExperts"}
 
+_DTYPE_BYTES = {"float32": 4, "int32": 4, "bfloat16": 2, "float16": 2,
+                "int8": 1, "uint8": 1, "bool": 1, "float64": 8, "int64": 8}
+
+
+def dtype_bytes(dtype: str) -> int:
+    """Bytes per element of a tensor dtype — the one sizing convention
+    shared by the simulator's transfer costing (4-byte default, matching
+    native/simulator.cc) and the regrid planner's hop pricing
+    (parallel/regrid.py)."""
+    return _DTYPE_BYTES.get(dtype, 4)
+
 
 def shard_flops(op: Op, pc: ParallelConfig) -> float:
     """Modeled fwd+bwd FLOPs of ONE shard: 3x forward (two extra GEMMs per

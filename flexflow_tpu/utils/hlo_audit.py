@@ -43,6 +43,7 @@ _DT = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1,
 
 _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
                 "collective-permute", "all-reduce-start", "all-gather-start",
+                "reduce-scatter-start", "all-to-all-start",
                 "collective-permute-start")
 
 
@@ -57,7 +58,9 @@ def collective_bytes(hlo: str, group_size: int) -> Tuple[float, float]:
         shape_s, op = m.group(1), m.group(2)
         if op not in _COLLECTIVES:
             continue
-        line = hlo[m.start():hlo.index("\n", m.start())]
+        # a collective on an unterminated final line must not raise
+        eol = hlo.find("\n", m.start())
+        line = hlo[m.start():eol if eol != -1 else len(hlo)]
         nbytes = 0
         for sm in re.finditer(r"([a-z0-9]+)\[([0-9,]*)\]", shape_s):
             dt, dims = sm.group(1), sm.group(2)

@@ -352,8 +352,9 @@ def test_bench_single_json_stdout_line(tmp_path, monkeypatch, capsys):
     def fake_run(model="inception", strategy_file=None, compile_cache=False,
                  **kw):
         print("library noise on stdout")  # must NOT reach real stdout
-        return 100.0, 800.0, 1.0, 0.5, {"windows": 1, "min": 99.0,
-                                        "max": 101.0}
+        return (100.0, 800.0, 1.0, 0.5,
+                {"windows": 1, "min": 99.0, "max": 101.0},
+                {"input_stall_s": 0.002, "regrid_hops": 3})
 
     monkeypatch.setattr(bench, "run", fake_run)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
@@ -364,6 +365,8 @@ def test_bench_single_json_stdout_line(tmp_path, monkeypatch, capsys):
     assert len(lines) == 1, f"stdout must be ONE JSON line, got {lines}"
     rec = json.loads(lines[0])
     assert rec["value"] == 100.0
+    # the round-6 execution-performance fields ride the metric line
+    assert rec["input_stall_s"] == 0.002 and rec["regrid_hops"] == 3
     assert "noise" in captured.err
     # run identity rides in the metric record, and the obs file has it
     assert rec["run_id"] and rec["obs_path"]
@@ -384,8 +387,9 @@ def test_bench_records_trace_path(tmp_path, monkeypatch, capsys):
 
     def fake_run(model="inception", strategy_file=None, compile_cache=False,
                  **kw):
-        return 100.0, 800.0, 1.0, None, {"windows": 1, "min": 99.0,
-                                         "max": 101.0}
+        return (100.0, 800.0, 1.0, None,
+                {"windows": 1, "min": 99.0, "max": 101.0},
+                {"input_stall_s": 0.0, "regrid_hops": 0})
 
     strat = tmp_path / "s.json"
     strat.write_text("{}")

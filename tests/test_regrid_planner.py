@@ -1,0 +1,182 @@
+"""Whole-graph regrid planner (parallel/regrid.py): equivalence with the
+legacy per-trace path, coalescing accounting, fan-out sharing, and
+cost-aware hop selection."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+
+def _hybrid_cnn(machine, planner, prefetch_depth, obs_dir=""):
+    """The AlexNet-shaped hybrid-strategy CNN (spatial + channel-TP +
+    linear-TP grids) used across the regrid tests."""
+    import __graft_entry__ as ge
+
+    devs = tuple(range(8))
+    s = Strategy()
+    s["conv1"] = ParallelConfig((2, 2, 1, 2), devs)
+    s["conv2"] = ParallelConfig((1, 1, 4, 2), devs)
+    s["linear1"] = ParallelConfig((4, 2), devs)
+    s["linear2"] = ParallelConfig((2, 4), devs)
+    ff, cfg = ge._tiny_model(machine, s)
+    cfg.regrid_planner = planner
+    cfg.prefetch_depth = prefetch_depth
+    cfg.num_iterations = 2
+    cfg.obs_dir = obs_dir
+    return ff, cfg
+
+
+def _fit_losses(machine, planner, prefetch_depth, obs_dir=""):
+    from flexflow_tpu.data import synthetic_batches
+
+    ff, cfg = _hybrid_cnn(machine, planner, prefetch_depth, obs_dir)
+    data = synthetic_batches(machine, cfg.batch_size, 32, 32, mode="ones")
+    out = ff.fit(data, log=lambda *a: None)
+    return ff, out
+
+
+def test_planner_bit_identical_and_obs_records(machine8, tmp_path):
+    """Planned-regrid execution (+ device prefetch) is loss-BIT-identical
+    to the legacy per-trace path on a hybrid strategy, and the run emits
+    the regrid_plan / prefetch obs records with coalescing visible."""
+    ff_on, out_on = _fit_losses(machine8, "on", 2, str(tmp_path))
+    ff_off, out_off = _fit_losses(machine8, "off", 0)
+    assert out_on["loss"] == out_off["loss"]  # exact, not approx
+    assert ff_off.regrid_plan_summary() is None
+    summ = ff_on.regrid_plan_summary()
+    assert summ["edges"] > 0
+    # the obs surface carries both round-6 records
+    from flexflow_tpu import obs
+
+    recs = list(obs.read_run(out_on["obs_path"]))
+    kinds = {r["kind"] for r in recs}
+    assert "regrid_plan" in kinds and "prefetch" in kinds
+    (rp,) = [r for r in recs if r["kind"] == "regrid_plan"]
+    assert rp["constraints_after"] < rp["constraints_before"]
+    (pf,) = [r for r in recs if r["kind"] == "prefetch"]
+    assert pf["depth"] == 2 and pf["batches"] >= 2
+    assert pf["input_stall_s"] >= 0.0
+    assert out_on["input_stall_s"] == pf["input_stall_s"]
+
+
+def test_coalescible_chain_strictly_reduces_constraints(machine8):
+    """A chain of consecutive ops sharing a grid (every edge a layout
+    no-op) coalesces to ZERO constraints; the per-edge count is strictly
+    reduced."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.model import FFModel
+
+    devs = tuple(range(8))
+    s = Strategy()
+    for name in ("linear1", "linear2", "linear3"):
+        s[name] = ParallelConfig((1, 8), devs)  # pure-DP: exit == want
+    cfg = FFConfig(batch_size=8, num_iterations=1, print_freq=0,
+                   num_classes=8)
+    cfg.strategies = s
+    ff = FFModel(cfg, machine8)
+    t = ff.create_input((8, 16), name="x")
+    t = ff.linear("linear1", t, 16)
+    t = ff.linear("linear2", t, 16)
+    t = ff.linear("linear3", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    summ = ff.regrid_plan_summary()
+    assert summ["noop_edges"] >= 2
+    assert summ["constraints_after"] < summ["constraints_before"]
+    # the coalesced edges carry no shardings at all
+    fusion, schedule = ff._plan(True)
+    plan = ff._regrid_plan_for(fusion, schedule)
+    for name in ("linear2", "linear3"):
+        ep = plan.edges.get((name, 0))
+        assert ep is not None and ep.shardings == []
+
+
+def test_fanout_shares_one_reshard(machine8):
+    """Two consumers of one producer wanting the same layout share one
+    planned reshard chain (and the plan says so)."""
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.model import FFModel
+
+    devs = tuple(range(8))
+    s = Strategy()
+    s["linear1"] = ParallelConfig((8, 1), devs)  # exit c-sharded
+    s["linear2"] = ParallelConfig((1, 8), devs)  # both want n-sharded,
+    s["linear3"] = ParallelConfig((1, 8), devs)  # c replicated
+    cfg = FFConfig(batch_size=8, num_iterations=1, print_freq=0,
+                   num_classes=8)
+    cfg.strategies = s
+    ff = FFModel(cfg, machine8)
+    x = ff.create_input((8, 16), name="x")
+    mid = ff.linear("linear1", x, 16)
+    a = ff.linear("linear2", mid, 8, relu=False)
+    ff.linear("linear3", mid, 8, relu=False)
+    ff.softmax("softmax", a)
+    summ = ff.regrid_plan_summary()
+    assert summ["shared_edges"] >= 1
+    fusion, schedule = ff._plan(True)
+    plan = ff._regrid_plan_for(fusion, schedule)
+    e2, e3 = plan.edges[("linear2", 0)], plan.edges[("linear3", 0)]
+    assert e2.share_key == e3.share_key is not None
+
+
+def test_cost_aware_hop_selection_beats_greedy():
+    """Where the greedy gather-first order inflates a later all-to-all
+    (moving after the per-shard size grew), the search moves while fully
+    sharded and gathers last — strictly cheaper under the topology's own
+    pricing."""
+    from flexflow_tpu.parallel.regrid import plan_hops, price_chain
+
+    m = MachineModel.virtual(8)
+    src = (("_g1",), ("_g0", "_g2"))
+    dst = (("_g1", "_g2"), ())
+    shape = (64, 64)
+    greedy = list(m.regrid_steps(src, dst)) + [dst]
+    greedy_s, _ = price_chain(m, src, greedy, shape)
+    chain, secs, _ = plan_hops(m, src, dst, shape)
+    assert chain[-1] == dst
+    assert secs < greedy_s
+    # the chosen first hop moves _g2 onto dim 0 BEFORE gathering _g0
+    assert chain[0] == (("_g1", "_g2"), ("_g0",))
+
+
+def test_plan_hops_reaches_inverted_orders():
+    """Order inversions the greedy cannot express (it returns None) are
+    reachable via gather+re-split — the planner never replicates the
+    whole tensor for them."""
+    from flexflow_tpu.parallel.regrid import plan_hops
+
+    m = MachineModel.virtual(8)
+    src = (("_g1", "_g0"), ())
+    dst = (("_g0", "_g1"), ())
+    assert m.regrid_steps(src, dst) is None  # the legacy fallback
+    chain, secs, _ = plan_hops(m, src, dst, (32, 32))
+    assert chain[-1] == dst
+    # never fully replicated: every intermediate keeps at least one axis
+    assert all(any(t for t in state) for state in chain[:-1])
+
+
+def test_planner_group_schedule_equivalence(machine8):
+    """Subset placements (placement-group members) under the planner stay
+    loss-bit-identical to the legacy path — group inputs use the plan's
+    edges too."""
+    import __graft_entry__ as ge
+
+    s = Strategy()
+    s["linear1"] = ParallelConfig((4, 1), (0, 1, 2, 3))
+    s["linear2"] = ParallelConfig((4, 1), (4, 5, 6, 7))
+    losses = {}
+    for mode in ("on", "off"):
+        ff, cfg = ge._tiny_model(machine8, s)
+        cfg.regrid_planner = mode
+        params, state = ff.init(seed=5)
+        opt = ff.init_opt_state(params)
+        step = ff.make_train_step()
+        img = np.ones((cfg.batch_size, 32, 32, 3), np.float32)
+        lbl = (np.arange(cfg.batch_size) % 16).astype(np.int32)
+        out = []
+        for _ in range(2):
+            params, state, opt, loss = step(params, state, opt, img, lbl)
+            out.append(float(loss))
+        losses[mode] = out
+    assert losses["on"] == losses["off"]
