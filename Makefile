@@ -1,7 +1,7 @@
 # Repo-level entry points; the native build lives in flexflow_tpu/native.
 PYTHON ?= python
 
-.PHONY: native check trace-smoke test bench-smoke
+.PHONY: native check trace-smoke test bench-smoke fault-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -30,3 +30,11 @@ bench-smoke:
 	assert 'regrid_hops' in rec and 'input_stall_s' in rec, rec; \
 	print('bench-smoke ok:', {k: rec[k] for k in \
 	('value','regrid_hops','input_stall_s')})"
+
+# deterministic fault-injection smoke (robustness round): loss_nan +
+# data_io injected into a tiny HDF5-fed run with --on-divergence
+# rollback; asserts the run completes with fault -> rollback -> recovery
+# obs records and a finite final loss, and that the guard is byte-inert
+# on a healthy run
+fault-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m flexflow_tpu.apps.fault_smoke

@@ -40,9 +40,12 @@ def _builders():
     return MODELS
 
 
-def make_data(cfg: FFConfig, machine: MachineModel, dataset=None):
+def make_data(cfg: FFConfig, machine: MachineModel, dataset=None,
+              olog=None):
     """Choose the input source the way the reference does: synthetic unless
-    -d was given (cnn.cc:79, README.md:68)."""
+    -d was given (cnn.cc:79, README.md:68).  File-backed sources run
+    under the retrying/skipping fault-tolerance layer and report
+    ``data_fault``/``recovery`` records on ``olog`` (caller-owned)."""
     from flexflow_tpu.data import (hdf5_batches, image_batches,
                                    synthetic_batches)
 
@@ -52,10 +55,14 @@ def make_data(cfg: FFConfig, machine: MachineModel, dataset=None):
                                  mode="random", seed=cfg.seed)
     if cfg.dataset_path.endswith((".h5", ".hdf5")):
         return hdf5_batches(machine, cfg.dataset_path.split(","),
-                            cfg.batch_size)
+                            cfg.batch_size, olog=olog,
+                            retry_attempts=cfg.data_retry_attempts,
+                            skip_budget=cfg.data_skip_budget)
     return image_batches(machine, dataset, cfg.batch_size, cfg.input_height,
                          cfg.input_width, num_threads=cfg.loaders_per_node,
-                         shuffle_seed=cfg.seed)
+                         shuffle_seed=cfg.seed, olog=olog,
+                         retry_attempts=cfg.data_retry_attempts,
+                         skip_budget=cfg.data_skip_budget)
 
 
 def main(argv=None, log=print) -> dict:
@@ -91,8 +98,17 @@ def main(argv=None, log=print) -> dict:
 
     ff = builders[model_name](cfg, machine)
     log(ff.summary())
-    data = make_data(cfg, machine, dataset)
-    out = ff.fit(data, log=log)
+    # the data surface's obs sink: file-backed sources emit data_fault /
+    # recovery / thread_leak records here (same run id as the fit stream
+    # when -run-id is set, so report renders them as one run)
+    from flexflow_tpu import obs
+
+    data_olog = obs.from_config(cfg, surface="data")
+    try:
+        data = make_data(cfg, machine, dataset, olog=data_olog)
+        out = ff.fit(data, log=log)
+    finally:
+        data_olog.close()
     out.pop("params", None)
     out.pop("state", None)
     return out

@@ -1,0 +1,165 @@
+"""Deterministic fault-injection smoke — the ``make fault-smoke`` entry
+point for the fault-tolerance runtime (robustness round).
+
+Two phases:
+
+  1. **equivalence** — with injection DISABLED, a guarded run
+     (``on_divergence=rollback``) must produce BIT-EQUAL losses to the
+     default-guarded run: the health guard adds no per-step host syncs
+     and never perturbs a healthy run;
+  2. **recovery** — a tiny CNN trains from an HDF5 source with
+     ``loss_nan`` injected into one step and a transient ``data_io``
+     fault injected into the reads, under ``--on-divergence rollback``
+     with periodic verified checkpoints.  The run must COMPLETE all
+     iterations with a finite final loss, and the obs stream must carry
+     the matching ``fault`` -> ``rollback`` -> ``recovery`` records
+     (plus the data-side retry records).
+
+Everything runs on CPU in seconds; assertion failures exit non-zero.
+
+    JAX_PLATFORMS=cpu python -m flexflow_tpu.apps.fault_smoke
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+FAULT_SPEC = "data_io@3x2,loss_nan@7"
+ITERS = 12
+
+
+def _build(cfg, machine):
+    from flexflow_tpu.model import FFModel
+
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((cfg.batch_size, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def _write_h5(path: str, n: int = 32) -> str:
+    import h5py
+
+    rng = np.random.RandomState(0)
+    with h5py.File(path, "w") as f:
+        f["images"] = rng.randint(0, 255, size=(n, 16, 16, 3),
+                                  dtype=np.uint8)
+        f["labels"] = rng.randint(0, 8, size=(n,)).astype(np.int32)
+    return path
+
+
+def _cfg(**kw):
+    from flexflow_tpu.config import FFConfig
+
+    base = dict(batch_size=8, input_height=16, input_width=16,
+                num_iterations=ITERS, print_freq=2, num_classes=8, seed=3)
+    base.update(kw)
+    return FFConfig(**base)
+
+
+def _check_equivalence(machine, log) -> None:
+    """Guarded-but-healthy == default: losses bit-equal, zero behavior
+    drift from the guard itself."""
+    from flexflow_tpu.data import synthetic_batches
+
+    def run(**kw):
+        ff = _build(_cfg(num_iterations=4, print_freq=0, **kw), machine)
+        data = synthetic_batches(machine, 8, 16, 16, num_classes=8,
+                                 mode="random", seed=3)
+        return ff.fit(data, log=lambda *a: None)["loss"]
+
+    a = run()                                 # default policy (halt)
+    b = run(on_divergence="rollback")         # guarded, no faults
+    assert a == b, f"guard must be byte-inert on healthy runs: {a} vs {b}"
+    log(f"equivalence ok: {len(a)} losses bit-equal with and without "
+        f"rollback policy")
+
+
+def main(argv=None, log=print) -> int:
+    try:
+        import h5py  # noqa: F401  (the data_io faults need a file source)
+    except ImportError:
+        log("fault-smoke requires h5py (the data_io faults target the "
+            "HDF5 source)")
+        return 2
+    from flexflow_tpu import obs
+    from flexflow_tpu.data.hdf5 import hdf5_batches
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.obs.report import summarize
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    machine = MachineModel()
+    _check_equivalence(machine, log)
+
+    with tempfile.TemporaryDirectory(prefix="ff-fault-smoke-") as td:
+        h5 = _write_h5(os.path.join(td, "data.h5"))
+        cfg = _cfg(ckpt_dir=os.path.join(td, "ckpt"), ckpt_freq=2,
+                   obs_dir=os.path.join(td, "obs"), run_id="fault-smoke",
+                   on_divergence="rollback", fault_spec=FAULT_SPEC)
+        ff = _build(cfg, machine)
+        data_olog = obs.from_config(cfg, surface="data")
+        try:
+            data = hdf5_batches(machine, [h5], cfg.batch_size,
+                                olog=data_olog,
+                                retry_attempts=cfg.data_retry_attempts,
+                                skip_budget=cfg.data_skip_budget)
+            out = ff.fit(data, log=log)
+        finally:
+            data_olog.close()
+
+        final = out["loss"][-1]
+        assert len(out["loss"]) == ITERS, \
+            f"run must complete all {ITERS} iterations, got " \
+            f"{len(out['loss'])}"
+        assert all(math.isfinite(l) for l in out["loss"]), \
+            f"post-rollback loss history must be finite: {out['loss']}"
+        assert out["rollbacks"] == 1, \
+            f"expected exactly one rollback, got {out['rollbacks']}"
+        last = ckpt.latest_step(cfg.ckpt_dir)
+        ok, why = ckpt.verify_checkpoint(cfg.ckpt_dir, last)
+        assert last == ITERS and ok, \
+            f"final checkpoint must verify clean: step {last}, {why}"
+
+        events = list(obs.read_run(out["obs_path"]))
+        kinds = [e["kind"] for e in events]
+
+        def first(kind, **match):
+            for i, e in enumerate(events):
+                if e["kind"] == kind and all(e.get(k) == v
+                                             for k, v in match.items()):
+                    return i
+            raise AssertionError(
+                f"missing {kind} {match} record in {sorted(set(kinds))}")
+
+        i_nan = first("fault", source="injected", fault="loss_nan")
+        i_det = first("fault", source="guard", fault="loss_divergence")
+        i_rb = first("rollback")
+        i_rec = first("recovery", source="guard", after="rollback")
+        assert i_nan < i_det < i_rb < i_rec, \
+            "records must read fault -> rollback -> recovery in order"
+        first("fault", source="injected", fault="data_io")
+        first("data_fault", source="hdf5", action="retry")
+        first("recovery", source="hdf5", after="retry")
+
+        summary = summarize(events)
+        assert "faults" in summary and \
+            summary["faults"]["counts"].get("rollback") == 1, summary
+
+        log(f"fault-smoke ok: {ITERS} iters survived "
+            f"{FAULT_SPEC!r} with 1 rollback, final loss {final:.4f}, "
+            f"records: " + ", ".join(
+                f"{k}={v}" for k, v in
+                sorted(summary['faults']['counts'].items())))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,20 @@ def parse_args(argv) -> RnnConfig:
             cfg.regrid_planner = val()
         elif a in ("-prefetch-depth", "--prefetch-depth"):
             cfg.prefetch_depth = int(val())
+        elif a == "--ckpt-dir":
+            cfg.ckpt_dir = val()
+        elif a == "--ckpt-freq":
+            cfg.ckpt_freq = int(val())
+        elif a in ("-on-divergence", "--on-divergence"):
+            from flexflow_tpu.config import _checked_policy
+
+            cfg.on_divergence = _checked_policy(val())
+        elif a in ("-max-rollbacks", "--max-rollbacks"):
+            cfg.max_rollbacks = int(val())
+        elif a in ("-fault-spec", "--fault-spec"):
+            from flexflow_tpu.config import _checked_fault_spec
+
+            cfg.fault_spec = _checked_fault_spec(val())
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg

@@ -8,7 +8,10 @@ Default mode renders a run's JSONL event stream (FFConfig.obs_dir /
 RunLog output, a search-trace artifact, or a bench log) into the summary
 tables humans read today: training step/loss/throughput, search best-cost
 trajectory with acceptance stats and the winning strategy's per-op cost
-breakdown, audit and bench records.  Several files render as one merged
+breakdown, audit and bench records, and the fault-tolerance family
+(``fault`` / ``rollback`` / ``recovery`` / ``data_fault`` /
+``ckpt_fallback`` / ``thread_leak``) — what failed and how the run
+survived it.  Several files render as one merged
 stream (e.g. a fit log plus the search trace that produced its strategy);
 rotated streams (``run.jsonl.1``, ...) are walked automatically.
 ``--json`` emits the same summary as ONE machine-readable JSON object on

@@ -10,6 +10,27 @@ from typing import List, Optional, Sequence
 from flexflow_tpu.strategy import Strategy
 
 
+def _checked_policy(v: str) -> str:
+    """Validate an --on-divergence value at parse time (like -delta)."""
+    if v not in ("halt", "warn", "rollback"):
+        raise SystemExit(
+            f"--on-divergence must be halt|warn|rollback, got {v!r}")
+    return v
+
+
+def _checked_fault_spec(v: str) -> str:
+    """Validate a --fault-spec string at parse time so a typo'd kind
+    fails loudly instead of never firing."""
+    from flexflow_tpu.utils.faultinject import FaultSpecError, \
+        parse_fault_spec
+
+    try:
+        parse_fault_spec(v)
+    except FaultSpecError as e:
+        raise SystemExit(f"--fault-spec: {e}")
+    return v
+
+
 @dataclasses.dataclass
 class FFConfig:
     # DefaultConfig parity (cnn.cc:23-35)
@@ -75,6 +96,23 @@ class FFConfig:
     # batches staged on device ahead of the training loop; 0 disables
     # (the legacy synchronous pull inside the timed loop)
     prefetch_depth: int = 2
+    # fault tolerance (robustness round): what the step health guard does
+    # when a loss window turns non-finite — "halt" (raise TrainingDiverged,
+    # the default), "warn" (log + obs record, keep training), "rollback"
+    # (restore the last VERIFIED checkpoint and continue on fresh data,
+    # at most max_rollbacks times).  Checks run only at print/checkpoint
+    # boundaries on already-accumulated device losses — zero per-step
+    # host syncs (utils/health.py).
+    on_divergence: str = "halt"
+    max_rollbacks: int = 3
+    # deterministic fault injection (utils/faultinject.py), e.g.
+    # "loss_nan@120,data_io@50x3,ckpt_truncate@2"; empty = disabled
+    fault_spec: str = ""
+    # retrying data sources (utils/retry.py): total read/decode attempts
+    # per item, and how many permanently-bad items a run may skip before
+    # giving up (data/hdf5.py, data/imagenet.py)
+    data_retry_attempts: int = 4
+    data_skip_budget: int = 16
 
     strategies: Strategy = dataclasses.field(default_factory=Strategy)
 
@@ -147,6 +185,16 @@ class FFConfig:
                 cfg.regrid_planner = val()
             elif a in ("-prefetch-depth", "--prefetch-depth"):
                 cfg.prefetch_depth = int(val())
+            elif a in ("-on-divergence", "--on-divergence"):
+                cfg.on_divergence = _checked_policy(val())
+            elif a in ("-max-rollbacks", "--max-rollbacks"):
+                cfg.max_rollbacks = int(val())
+            elif a in ("-fault-spec", "--fault-spec"):
+                cfg.fault_spec = _checked_fault_spec(val())
+            elif a == "--data-retry-attempts":
+                cfg.data_retry_attempts = int(val())
+            elif a == "--data-skip-budget":
+                cfg.data_skip_budget = int(val())
             elif a == "--ckpt-dir":
                 cfg.ckpt_dir = val()
             elif a == "--ckpt-freq":

@@ -6,17 +6,31 @@ the next batch into zero-copy memory while the current one trains).
 
 Images may be stored uint8 HWC (normalized here with the same
 ``(u8/256 - mean)/std`` rule as the JPEG path) or float32 (passed through).
+
+Fault tolerance (robustness round): every chunk read runs under the
+bounded-retry policy of utils/retry.py (exponential backoff,
+deterministic jitter), so one transient I/O error no longer kills a run;
+a range that keeps failing past the retry budget is SKIPPED — the cursor
+advances, a ``data_fault`` obs record is emitted, and only when the
+per-run ``skip_budget`` is exhausted does the stream raise.  The
+deterministic fault harness (utils/faultinject.py, kind ``data_io``)
+exercises both paths at exact read indices.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import warnings
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from flexflow_tpu.data.imagenet import IMAGENET_MEAN, IMAGENET_STD
+
+# how long teardown waits for the prefetch thread before declaring it
+# leaked (module-level so tests can shrink it)
+_JOIN_TIMEOUT_S = 2.0
 
 
 def _read_batch(files: List, positions: List[int], file_idx: int,
@@ -56,24 +70,76 @@ def _normalize(img: np.ndarray) -> np.ndarray:
 
 
 def hdf5_batches(machine, paths: List[str], batch_size: int,
-                 prefetch: int = 2, place: bool = True) -> Iterator[Tuple]:
+                 prefetch: int = 2, place: bool = True, olog=None,
+                 retry_attempts: int = 4,
+                 skip_budget: int = 16) -> Iterator[Tuple]:
     """Yield (images, labels) forever from HDF5 batch files, prefetching on
     a background thread.  ``place=False`` yields host numpy batches and
     leaves the sharded ``device_put`` to the caller's DevicePrefetcher
-    (data/prefetch.py) so H2D staging overlaps compute."""
+    (data/prefetch.py) so H2D staging overlaps compute.
+
+    Transient ``OSError`` reads are retried (``retry_attempts`` total
+    tries with backoff); a permanently failing range is skipped — cursor
+    advanced, ``data_fault`` obs record on ``olog`` — until
+    ``skip_budget`` is spent.  ``olog`` is any obs sink (not owned here;
+    the caller closes it)."""
     import h5py
     import jax
 
+    from flexflow_tpu import obs
     from flexflow_tpu.data.synthetic import _batch_sharding
+    from flexflow_tpu.utils import faultinject
+    from flexflow_tpu.utils.retry import RetryPolicy, call_with_retry
 
     if not paths:
         raise ValueError("hdf5_batches needs at least one file")
+    olog = olog if olog is not None else obs.NULL
     sharding = _batch_sharding(machine) if place else None
     files = [h5py.File(p, "r") for p in paths]
     positions = [0] * len(files)
+    policy = RetryPolicy(attempts=max(int(retry_attempts), 1))
 
     q: "queue.Queue" = queue.Queue(maxsize=prefetch)
     stop = threading.Event()
+    skips = [0]
+
+    def read_resilient(idx):
+        """One batch read under retry; a range failing past the retry
+        budget is skipped (bounded by skip_budget) instead of killing
+        the run."""
+        while True:
+            fidx = idx
+
+            def once():
+                faultinject.raise_if("data_io", site=f"hdf5:{paths[fidx]}")
+                return _read_batch(files, positions, fidx, batch_size)
+
+            try:
+                return call_with_retry(
+                    once, policy, retry_on=(OSError,),
+                    on_retry=lambda e, n, d: olog.event(
+                        "data_fault", source="hdf5", action="retry",
+                        attempt=n, delay_s=d, error=str(e)),
+                    on_recover=lambda n: olog.event(
+                        "recovery", source="hdf5", after="retry",
+                        failures=n))
+            except OSError as e:
+                skips[0] += 1
+                if skips[0] > skip_budget:
+                    raise RuntimeError(
+                        f"hdf5 read skip budget ({skip_budget}) "
+                        f"exhausted") from e
+                warnings.warn(
+                    f"hdf5: skipping a batch range after "
+                    f"{policy.attempts} failed reads: {e}",
+                    RuntimeWarning)
+                olog.event("data_fault", source="hdf5", action="skip",
+                           skips=skips[0], error=str(e))
+                try:
+                    n = files[idx]["images"].shape[0]
+                    positions[idx] = (positions[idx] + batch_size) % n
+                except Exception:
+                    idx = (idx + 1) % len(files)
 
     def producer():
         # The producer owns the files: only it touches them, and it closes
@@ -83,8 +149,7 @@ def hdf5_batches(machine, paths: List[str], batch_size: int,
             idx = 0
             while not stop.is_set():
                 try:
-                    img, lbl, idx = _read_batch(files, positions, idx,
-                                                batch_size)
+                    img, lbl, idx = read_resilient(idx)
                     item = (_normalize(img), np.asarray(lbl, np.int32))
                 except Exception as e:  # surface to consumer, don't hang it
                     item = _ProducerError(e)
@@ -118,4 +183,13 @@ def hdf5_batches(machine, paths: List[str], batch_size: int,
                        jax.device_put(lbl, sharding))
     finally:
         stop.set()
-        t.join(timeout=2.0)
+        t.join(timeout=_JOIN_TIMEOUT_S)
+        if t.is_alive():
+            # a silently failed join used to pretend shutdown succeeded;
+            # the thread is daemonic, but say that it leaked
+            warnings.warn(
+                f"hdf5 prefetch thread did not exit within "
+                f"{_JOIN_TIMEOUT_S:.1f}s; leaking the daemon thread",
+                RuntimeWarning)
+            olog.event("thread_leak", source="hdf5_batches",
+                       timeout_s=_JOIN_TIMEOUT_S)

@@ -22,6 +22,7 @@ prefetch).  Falls back to PIL when the native library is unavailable.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -82,33 +83,41 @@ class ImageDataset:
         return labels, files
 
 
+def _decode_one(path: str, height: int, width: int) -> np.ndarray:
+    """Decode + resize + normalize ONE file (the retry/skip unit of the
+    fault-tolerant pipeline; PIL raises OSError subclasses on corrupt or
+    unreadable files)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        arr = np.asarray(im.convert("RGB"), np.uint8)
+    oh, ow = arr.shape[:2]
+    # floor(v + 0.5): half-away-from-zero, matching the native loader
+    # and the reference's roundf (np.round would round half to even)
+    ys = np.minimum(np.floor(np.arange(height) * (oh / height) + 0.5)
+                    .astype(np.int64), oh - 1)
+    xs = np.minimum(np.floor(np.arange(width) * (ow / width) + 0.5)
+                    .astype(np.int64), ow - 1)
+    resized = arr[ys][:, xs].astype(np.float32)
+    return (resized / 256.0 - IMAGENET_MEAN) / IMAGENET_STD
+
+
 def decode_batch_pil(files: List[str], height: int,
                      width: int) -> np.ndarray:
     """PIL fallback decode path, same resize/normalize semantics as the
-    native loader (nearest index = round(y*scale) clamped)."""
-    from PIL import Image
-
+    native loader."""
     out = np.zeros((len(files), height, width, 3), np.float32)
     for i, f in enumerate(files):
-        with Image.open(f) as im:
-            arr = np.asarray(im.convert("RGB"), np.uint8)
-        oh, ow = arr.shape[:2]
-        # floor(v + 0.5): half-away-from-zero, matching the native loader
-        # and the reference's roundf (np.round would round half to even)
-        ys = np.minimum(np.floor(np.arange(height) * (oh / height) + 0.5)
-                        .astype(np.int64), oh - 1)
-        xs = np.minimum(np.floor(np.arange(width) * (ow / width) + 0.5)
-                        .astype(np.int64), ow - 1)
-        resized = arr[ys][:, xs].astype(np.float32)
-        out[i] = (resized / 256.0 - IMAGENET_MEAN) / IMAGENET_STD
+        out[i] = _decode_one(f, height, width)
     return out
 
 
 def image_batches(machine, dataset: ImageDataset, batch_size: int,
                   height: int, width: int, num_threads: int = 4,
                   prefetch: int = 2, shuffle_seed: Optional[int] = 0,
-                  use_native: bool = True,
-                  place: bool = True) -> Iterator[Tuple]:
+                  use_native: bool = True, place: bool = True,
+                  olog=None, retry_attempts: int = 4,
+                  skip_budget: int = 16) -> Iterator[Tuple]:
     """Yield (images NHWC float32 sharded, labels int32 sharded) forever,
     with `prefetch` batches of JPEG decode in flight.
 
@@ -116,14 +125,26 @@ def image_batches(machine, dataset: ImageDataset, batch_size: int,
     the caller's :class:`~flexflow_tpu.data.prefetch.DevicePrefetcher`
     (fit() wraps every source with one) then does the sharded
     ``device_put`` on its staging thread, overlapping H2D with the
-    previous step's compute instead of paying it here."""
+    previous step's compute instead of paying it here.
+
+    Fault tolerance (PIL decode path): a transient ``OSError`` on one
+    file is retried under the bounded backoff policy of utils/retry.py;
+    a PERMANENTLY corrupt sample is skipped — replaced by the dataset's
+    next sample, with a ``data_fault`` obs record on ``olog`` — until
+    ``skip_budget`` is spent.  The native loader decodes out-of-process
+    and keeps its own error handling."""
     import jax
 
+    from flexflow_tpu import obs
     from flexflow_tpu.data.synthetic import _batch_sharding
+    from flexflow_tpu.utils import faultinject
+    from flexflow_tpu.utils.retry import RetryPolicy, call_with_retry
 
     if shuffle_seed is not None:
         dataset.shuffle_samples(shuffle_seed)
+    olog = olog if olog is not None else obs.NULL
     sharding = _batch_sharding(machine) if place else None
+    policy = RetryPolicy(attempts=max(int(retry_attempts), 1))
 
     def commit(img, lbl):
         if sharding is None:
@@ -150,7 +171,46 @@ def image_batches(machine, dataset: ImageDataset, batch_size: int,
             loader.submit(files, lbls)  # keep the pipeline full
             yield commit(img, lbl)
     else:
+        skips = 0
         while True:
             lbls, files = dataset.get_samples(batch_size)
-            img = decode_batch_pil(files, height, width)
+            lbls, files = list(lbls), list(files)
+            img = np.zeros((batch_size, height, width, 3), np.float32)
+            for i in range(batch_size):
+                while True:
+                    f = files[i]
+
+                    def once(path=f):
+                        faultinject.raise_if("data_io",
+                                             site=f"imagenet:{path}")
+                        return _decode_one(path, height, width)
+
+                    try:
+                        img[i] = call_with_retry(
+                            once, policy, retry_on=(OSError,),
+                            on_retry=lambda e, n, d: olog.event(
+                                "data_fault", source="imagenet",
+                                action="retry", file=f, attempt=n,
+                                delay_s=d, error=str(e)),
+                            on_recover=lambda n: olog.event(
+                                "recovery", source="imagenet",
+                                after="retry", file=f, failures=n))
+                        break
+                    except OSError as e:
+                        # permanently corrupt sample: skip it (bounded)
+                        # and take the dataset's next sample instead
+                        skips += 1
+                        if skips > skip_budget:
+                            raise RuntimeError(
+                                f"imagenet decode skip budget "
+                                f"({skip_budget}) exhausted") from e
+                        warnings.warn(
+                            f"imagenet: skipping corrupt sample {f!r} "
+                            f"after {policy.attempts} decode attempts: "
+                            f"{e}", RuntimeWarning)
+                        olog.event("data_fault", source="imagenet",
+                                   action="skip", file=f, skips=skips,
+                                   error=str(e))
+                        (rl,), (rf,) = dataset.get_samples(1)
+                        lbls[i], files[i] = rl, rf
             yield commit(img, lbls)

@@ -34,9 +34,14 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import warnings
 from typing import Iterator, Optional
 
 _STOP_POLL_S = 0.1
+
+# how long close() waits for the worker before declaring the thread
+# leaked (module-level so tests can shrink it)
+_JOIN_TIMEOUT_S = 2.0
 
 
 class _Failure:
@@ -60,12 +65,15 @@ class DevicePrefetcher:
     ``machine=None`` disables placement entirely (pure read-ahead).
     """
 
-    def __init__(self, upstream: Iterator, machine=None, depth: int = 2):
+    def __init__(self, upstream: Iterator, machine=None, depth: int = 2,
+                 olog=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.depth = depth
         self.stall_s = 0.0
         self.batches = 0
+        self.leaked = False
+        self._olog = olog
         self._upstream = upstream
         self._sharding = None
         if machine is not None and machine.num_devices >= 1:
@@ -141,7 +149,10 @@ class DevicePrefetcher:
     def close(self) -> None:
         """Stop the worker (unblocking a put-in-progress) and join it.
         Idempotent; also runs at GC so an abandoned prefetcher never
-        leaks its thread."""
+        leaks its thread.  A join that times out (a worker stuck in the
+        upstream iterator) is DETECTED and reported — previously the
+        failure was silent and the thread leaked while shutdown claimed
+        success."""
         self._stop.set()
         try:
             while True:
@@ -151,7 +162,19 @@ class DevicePrefetcher:
         t = self._thread
         if t is not None and t.is_alive() \
                 and t is not threading.current_thread():
-            t.join(timeout=2.0)
+            t.join(timeout=_JOIN_TIMEOUT_S)
+            if t.is_alive() and not self.leaked:
+                self.leaked = True
+                warnings.warn(
+                    f"DevicePrefetcher worker did not exit within "
+                    f"{_JOIN_TIMEOUT_S:.1f}s (stuck in the upstream "
+                    f"iterator?); leaking the daemon thread",
+                    RuntimeWarning)
+                if self._olog is not None \
+                        and getattr(self._olog, "enabled", False):
+                    self._olog.event("thread_leak",
+                                     source="DevicePrefetcher",
+                                     timeout_s=_JOIN_TIMEOUT_S)
 
     def __enter__(self):
         return self
@@ -169,7 +192,7 @@ class DevicePrefetcher:
     def summary(self) -> dict:
         """The ``prefetch`` obs record body."""
         return {"depth": self.depth, "batches": self.batches,
-                "input_stall_s": self.stall_s}
+                "input_stall_s": self.stall_s, "leaked": self.leaked}
 
 
 def prefetch_batches(upstream: Iterator, machine=None,

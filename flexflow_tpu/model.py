@@ -1214,11 +1214,8 @@ class FFModel:
 
     def fit(self, data_iter, num_iterations: Optional[int] = None,
             warmup: int = 1, log=print):
-        import contextlib
-
-        import jax
-
         from flexflow_tpu import obs
+        from flexflow_tpu.utils import faultinject
 
         num_iterations = num_iterations or self.config.num_iterations
         # run telemetry (obs subsystem): a live JSONL sink when
@@ -1233,6 +1230,27 @@ class FFModel:
                   "iterations": num_iterations,
                   "compute_dtype": self.config.compute_dtype,
                   "strategy_ops": len(self.config.strategies)})
+        # deterministic fault injection (utils/faultinject.py): installed
+        # process-globally for the run so background data threads see the
+        # same schedule; the previous injector is restored on every exit
+        # path (a leaked injector would fire into the next run)
+        inj = faultinject.from_config(self.config, olog=olog)
+        prev_inj = faultinject.install(inj) if inj.enabled else None
+        try:
+            return self._fit(data_iter, num_iterations, warmup, log,
+                             olog, inj)
+        finally:
+            if prev_inj is not None:
+                faultinject.install(prev_inj)
+            olog.close()
+
+    def _fit(self, data_iter, num_iterations, warmup, log, olog, inj):
+        import contextlib
+
+        import jax
+
+        from flexflow_tpu.utils import checkpoint as ckpt
+        from flexflow_tpu.utils.health import StepHealthGuard
 
         if getattr(self.config, "dry_compile", False):
             # DISABLE_COMPUTATION analog (ops.h:19): run the whole graph/
@@ -1250,7 +1268,6 @@ class FFModel:
                        bytes_accessed=float(cost.get("bytes accessed",
                                                      0.0)),
                        dry=True)
-            olog.close()
             log(f"dry-compile ok: {len(self.layers)} layers, "
                 f"flops/step = {cost.get('flops', 0.0):.3e}, "
                 f"argument bytes = "
@@ -1266,17 +1283,18 @@ class FFModel:
         ckpt_dir = getattr(self.config, "ckpt_dir", "")
         ckpt_freq = getattr(self.config, "ckpt_freq", 0)
         if ckpt_dir:
-            from flexflow_tpu.utils import checkpoint as ckpt
-
             if ckpt.latest_step(ckpt_dir) is not None:
                 t0 = time.perf_counter()
+                # verified restore with latest -> older fallback cascade
+                # (utils/checkpoint.py); a corrupt latest step costs one
+                # checkpoint interval, not the run
                 start_iter, params, state, opt_state = \
-                    ckpt.restore_checkpoint(ckpt_dir, self)
+                    ckpt.restore_checkpoint(ckpt_dir, self, olog=olog)
                 olog.event("checkpoint_restore", step=start_iter,
                            seconds=time.perf_counter() - t0, dir=ckpt_dir)
                 resumed = True
                 opt_state = opt_state or self.init_opt_state(params)
-                saved = ckpt.load_strategy(ckpt_dir)
+                saved = ckpt.load_strategy(ckpt_dir, step=start_iter)
                 if saved is not None \
                         and dict(saved) != dict(self.config.strategies):
                     log("warning: checkpoint was trained under a different "
@@ -1284,8 +1302,17 @@ class FFModel:
                 log(f"resumed from {ckpt_dir} at iteration {start_iter}")
                 # re-align a deterministic (seeded) data stream with the
                 # restored position so resume matches the uninterrupted run
-                for _ in range(min(start_iter, num_iterations)):
-                    next(data_iter)
+                skip = min(start_iter, num_iterations)
+                try:
+                    for _ in range(skip):
+                        next(data_iter)
+                except StopIteration:
+                    raise RuntimeError(
+                        f"checkpoint at step {start_iter} is ahead of the "
+                        f"data stream: the stream ended before yielding "
+                        f"the {skip} batches needed to re-align resume — "
+                        f"regenerate the stream, or point ckpt_dir at a "
+                        f"checkpoint matching this data") from None
         if not resumed:
             params, state = self.init()
             opt_state = self.init_opt_state(params)
@@ -1300,11 +1327,19 @@ class FFModel:
             from flexflow_tpu.data.prefetch import DevicePrefetcher
 
             prefetcher = DevicePrefetcher(data_iter, machine=self.machine,
-                                          depth=_depth)
+                                          depth=_depth, olog=olog)
             data_iter = iter(prefetcher)
         step = self.make_train_step()
         warmup = start_iter + min(warmup,
                                   max(num_iterations - start_iter - 1, 0))
+        # step health guard (utils/health.py): windowed finite-loss checks
+        # at print/checkpoint boundaries only — the window's device losses
+        # are already accumulated, so no per-step host sync is added and
+        # a healthy run is byte-identical to an unguarded one
+        guard = StepHealthGuard(
+            policy=getattr(self.config, "on_divergence", "halt"),
+            max_rollbacks=int(getattr(self.config, "max_rollbacks", 3)),
+            olog=olog, log=log)
 
         trace_ctx = contextlib.nullcontext()
         if getattr(self.config, "trace_dir", ""):
@@ -1336,8 +1371,14 @@ class FFModel:
         op_samples = []
         start = time.perf_counter()
         loss = None
+        # loss_base: absolute step of losses[0] (rollback may restore to
+        # a step older than the resume point); window_start: first step
+        # of the guard's current loss window
+        loss_base = start_iter
+        window_start = start_iter
         with trace_ctx:
-            for it in range(start_iter, num_iterations):
+            it = start_iter
+            while it < num_iterations:
                 batch = next(data_iter)
                 if it == warmup:
                     if loss is not None:
@@ -1351,20 +1392,56 @@ class FFModel:
                 else:
                     params, state, opt_state, loss = step(
                         params, state, opt_state, *batch)
+                if inj.enabled and inj.fire("loss_nan", site="fit"):
+                    # poison the RECORDED loss device-side (no host sync);
+                    # the guard must detect it at the next boundary
+                    loss = loss * float("nan")
                 losses.append(loss)
                 if clock is not None:
                     clock.tick()
-                if self.config.print_freq \
-                        and (it + 1) % self.config.print_freq == 0:
-                    log(f"iter {it + 1}: loss = {float(loss):.4f}")
-                if ckpt_dir and ckpt_freq and (it + 1) % ckpt_freq == 0 \
-                        and it + 1 < num_iterations:
+                it1 = it + 1
+                at_print = bool(self.config.print_freq) \
+                    and it1 % self.config.print_freq == 0
+                at_ckpt = bool(ckpt_dir) and bool(ckpt_freq) \
+                    and it1 % ckpt_freq == 0 and it1 < num_iterations
+                if at_print or at_ckpt or it1 == num_iterations:
+                    # guard check rides boundaries that host-sync anyway
+                    # (print's float(loss), the save's device_get)
+                    action = guard.check(
+                        losses[window_start - loss_base:],
+                        first_step=window_start + 1)
+                    if action == "rollback":
+                        rstep, params, state, opt_state = \
+                            self._rollback_restore(ckpt_dir, olog, log, it1)
+                        del losses[max(rstep - loss_base, 0):]
+                        loss_base = min(loss_base, rstep)
+                        loss = None
+                        window_start = rstep
+                        # the data stream is NOT rewound: steps re-run on
+                        # fresh batches, advancing past the bad window
+                        it = rstep
+                        continue
+                    window_start = it1
+                if at_print:
+                    log(f"iter {it1}: loss = {float(loss):.4f}")
+                if at_ckpt:
                     t0 = time.perf_counter()
-                    ckpt.save_checkpoint(ckpt_dir, it + 1, params, state,
-                                         opt_state, self.config.strategies)
-                    olog.event("checkpoint_save", step=it + 1,
-                               seconds=time.perf_counter() - t0,
-                               dir=ckpt_dir)
+                    try:
+                        ckpt.save_checkpoint(ckpt_dir, it1, params, state,
+                                             opt_state,
+                                             self.config.strategies)
+                        olog.event("checkpoint_save", step=it1,
+                                   seconds=time.perf_counter() - t0,
+                                   dir=ckpt_dir)
+                    except ckpt.NonFiniteCheckpointError as e:
+                        # never commit non-finite state over good
+                        # checkpoints; the guard decides the run's fate
+                        olog.event("fault", source="checkpoint",
+                                   fault="nonfinite_state", step=it1,
+                                   error=str(e))
+                        log(f"warning: skipped checkpoint at iteration "
+                            f"{it1}: {e}")
+                it += 1
             if loss is not None:
                 float(loss)
             elapsed = time.perf_counter() - start
@@ -1374,10 +1451,17 @@ class FFModel:
             prefetcher.close()
         if ckpt_dir and start_iter < num_iterations:
             t0 = time.perf_counter()
-            ckpt.save_checkpoint(ckpt_dir, num_iterations, params, state,
-                                 opt_state, self.config.strategies)
-            olog.event("checkpoint_save", step=num_iterations,
-                       seconds=time.perf_counter() - t0, dir=ckpt_dir)
+            try:
+                ckpt.save_checkpoint(ckpt_dir, num_iterations, params,
+                                     state, opt_state,
+                                     self.config.strategies)
+                olog.event("checkpoint_save", step=num_iterations,
+                           seconds=time.perf_counter() - t0, dir=ckpt_dir)
+            except ckpt.NonFiniteCheckpointError as e:
+                olog.event("fault", source="checkpoint",
+                           fault="nonfinite_state", step=num_iterations,
+                           error=str(e))
+                log(f"warning: skipped final checkpoint: {e}")
         # the one bulk device->host transfer of the whole loss history
         losses = [float(l) for l in jax.device_get(losses)]
         n_timed = num_iterations - warmup
@@ -1426,14 +1510,42 @@ class FFModel:
                 except Exception as e:
                     log(f"step roofline unavailable: {e}")
             log(OpProfiler(self).report())
-        olog.close()
         return {
             "params": params, "state": state,
             "loss": losses,
             "elapsed_s": elapsed, "images_per_sec": throughput,
             "input_stall_s": prefetcher.stall_s if prefetcher else 0.0,
+            "rollbacks": guard.rollbacks,
             "run_id": olog.run_id, "obs_path": olog.path,
         }
+
+    def _rollback_restore(self, ckpt_dir, olog, log, from_step):
+        """The health guard's rollback: restore the last VERIFIED
+        checkpoint (cascading past corrupt steps) and return
+        ``(step, params, state, opt_state)``.  Without a usable
+        checkpoint the run restarts from a fresh init at step 0.  The
+        data stream is never rewound — re-run steps consume fresh
+        batches, which is what lets a one-off bad window be skipped."""
+        from flexflow_tpu.utils import checkpoint as ckpt
+
+        rstep, params, state, opt_state = 0, None, None, None
+        if ckpt_dir:
+            try:
+                rstep, params, state, opt_state = \
+                    ckpt.restore_checkpoint(ckpt_dir, self, olog=olog)
+            except (FileNotFoundError, ckpt.CheckpointError) as e:
+                log(f"rollback: no usable checkpoint under {ckpt_dir!r} "
+                    f"({e}); reinitializing from step 0")
+        if params is None:
+            rstep = 0
+            params, state = self.init()
+            opt_state = None
+        opt_state = opt_state or self.init_opt_state(params)
+        olog.event("rollback", from_step=from_step, to_step=rstep,
+                   dir=ckpt_dir or None)
+        log(f"health guard: rolled back from iteration {from_step} to "
+            f"checkpoint step {rstep}")
+        return rstep, params, state, opt_state
 
     def _make_section_fns(self):
         """Jitted forward and forward+backward sections of the train step

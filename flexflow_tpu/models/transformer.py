@@ -51,6 +51,12 @@ class TransformerConfig:
     # execution performance (forwarded to FFConfig; round 6)
     regrid_planner: str = "on"
     prefetch_depth: int = 2
+    # fault tolerance (forwarded to FFConfig; robustness round)
+    ckpt_dir: str = ""
+    ckpt_freq: int = 0
+    on_divergence: str = "halt"
+    max_rollbacks: int = 3
+    fault_spec: str = ""
 
 
 class TransformerLM(FFModel):
@@ -76,6 +82,11 @@ class TransformerLM(FFModel):
             run_id=self.t.run_id,
             regrid_planner=self.t.regrid_planner,
             prefetch_depth=self.t.prefetch_depth,
+            ckpt_dir=self.t.ckpt_dir,
+            ckpt_freq=self.t.ckpt_freq,
+            on_divergence=self.t.on_divergence,
+            max_rollbacks=self.t.max_rollbacks,
+            fault_spec=self.t.fault_spec,
             strategies=strategies or Strategy(),
         )
         super().__init__(ff_cfg, machine)

@@ -62,6 +62,12 @@ class RnnConfig:
     # execution performance (forwarded to FFConfig; round 6)
     regrid_planner: str = "on"
     prefetch_depth: int = 2
+    # fault tolerance (forwarded to FFConfig; robustness round)
+    ckpt_dir: str = ""
+    ckpt_freq: int = 0
+    on_divergence: str = "halt"
+    max_rollbacks: int = 3
+    fault_spec: str = ""
 
     @property
     def chunks_per_seq(self) -> int:
@@ -147,6 +153,11 @@ class RnnModel(FFModel):
             run_id=self.rnn.run_id,
             regrid_planner=self.rnn.regrid_planner,
             prefetch_depth=self.rnn.prefetch_depth,
+            ckpt_dir=self.rnn.ckpt_dir,
+            ckpt_freq=self.rnn.ckpt_freq,
+            on_divergence=self.rnn.on_divergence,
+            max_rollbacks=self.rnn.max_rollbacks,
+            fault_spec=self.rnn.fault_spec,
             strategies=strategies,
         )
         super().__init__(ff_cfg, machine)

@@ -17,10 +17,19 @@ fished out of mixed stdout.  This package gives them ONE record schema:
     ``checkpoint_restore`` / ``sim_drift`` (training, model.py::fit),
     ``search_space`` / ``search_chunk`` / ``search_result`` /
     ``search_breakdown`` / ``pipeline_candidate`` / ``pipeline_decision``
-    (sim/search.py), ``hlo_audit`` / ``bench`` (audit/bench), and the
+    (sim/search.py), ``hlo_audit`` / ``bench`` (audit/bench), the
     execution-performance pair (round 6) — ``regrid_plan`` (the regrid
     planner's coalescing/hop accounting, parallel/regrid.py) and
-    ``prefetch`` (device-prefetch stall residual, data/prefetch.py);
+    ``prefetch`` (device-prefetch stall residual, data/prefetch.py) —
+    and the fault-tolerance family (robustness round): ``fault`` (an
+    injected fault firing, a health-guard divergence detection, or a
+    refused non-finite checkpoint), ``rollback`` (guard-driven restore
+    of the last verified checkpoint), ``recovery`` (a clean window after
+    rollback, or a read succeeding after retries), ``data_fault``
+    (retried/skipped data reads, data/hdf5.py + data/imagenet.py),
+    ``ckpt_fallback`` (restore cascading past a corrupt step,
+    utils/checkpoint.py) and ``thread_leak`` (a worker join that timed
+    out at shutdown);
   * :class:`RunLog` is the thread-safe sink; :class:`NullRunLog` (the
     module-level ``NULL``) is the disabled sink whose every method is a
     no-op, so instrumented code pays one predicate/attribute check when

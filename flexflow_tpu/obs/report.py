@@ -151,6 +151,66 @@ def _fit_section(events: List[Dict]) -> List[str]:
     return lines
 
 
+def _fault_section(events: List[Dict]) -> List[str]:
+    """The fault-tolerance records (robustness round): injected faults,
+    guard detections, rollbacks, recoveries, data retries/skips,
+    checkpoint fallbacks, leaked worker threads."""
+    faults = [e for e in events if e.get("kind") == "fault"]
+    rollbacks = [e for e in events if e.get("kind") == "rollback"]
+    recoveries = [e for e in events if e.get("kind") == "recovery"]
+    data_faults = [e for e in events if e.get("kind") == "data_fault"]
+    fallbacks = [e for e in events if e.get("kind") == "ckpt_fallback"]
+    leaks = [e for e in events if e.get("kind") == "thread_leak"]
+    if not (faults or rollbacks or recoveries or data_faults or fallbacks
+            or leaks):
+        return []
+    lines = ["== faults / recovery =="]
+    for f in faults:
+        where = ""
+        if f.get("step") is not None:
+            where = f" at step {f['step']}"
+        elif f.get("occurrence") is not None:
+            where = f" (occurrence {f['occurrence']})"
+        detail = ""
+        if f.get("value") is not None:
+            detail = f", loss={f['value']}"
+        elif f.get("site"):
+            detail = f", site={f['site']}"
+        lines.append(f"  fault[{f.get('source', '?')}]: "
+                     f"{f.get('fault', '?')}{where}{detail}")
+    retries = [d for d in data_faults if d.get("action") == "retry"]
+    if retries:
+        srcs = sorted({str(d.get("source")) for d in retries})
+        lines.append(f"  data retries: {len(retries)} "
+                     f"({', '.join(srcs)})")
+    for d in data_faults:
+        if d.get("action") == "skip":
+            lines.append(
+                f"  data skip[{d.get('source', '?')}]: "
+                f"{d.get('file') or 'batch range'} "
+                f"(skip #{d.get('skips', '?')}: {d.get('error', '?')})")
+    for c in fallbacks:
+        skipped = c.get("skipped") or []
+        why = "; ".join(f"step {s.get('step')}: {s.get('reason')}"
+                        for s in skipped if isinstance(s, dict))
+        lines.append(f"  ckpt_fallback: step {c.get('from_step', '?')} -> "
+                     f"{c.get('to_step', '?')}" + (f" ({why})" if why
+                                                   else ""))
+    for r in rollbacks:
+        lines.append(f"  rollback: iteration {r.get('from_step', '?')} -> "
+                     f"checkpoint step {r.get('to_step', '?')}")
+    for r in recoveries:
+        after = r.get("after", "?")
+        spot = (f"step {r['step']}" if r.get("step") is not None
+                else f"{r.get('failures', '?')} failures")
+        lines.append(f"  recovery[{r.get('source', '?')}]: after {after} "
+                     f"({spot})")
+    for l in leaks:
+        lines.append(f"  thread leak: {l.get('source', '?')} (join timed "
+                     f"out after {l.get('timeout_s', '?')}s)")
+    return lines
+
+
 def _search_section(events: List[Dict]) -> List[str]:
     space = [e for e in events if e.get("kind") == "search_space"]
     chunks = [e for e in events if e.get("kind") == "search_chunk"]
@@ -261,7 +321,9 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "op_time", "sim_trace", "search_space",
              "search_chunk", "search_result", "search_breakdown",
              "pipeline_candidate", "pipeline_decision", "hlo_audit",
-             "bench"}
+             "bench", "regrid_plan", "prefetch",
+             "fault", "rollback", "recovery", "data_fault",
+             "ckpt_fallback", "thread_leak"}
     lines = []
     for e in events:
         kind = e.get("kind")
@@ -287,8 +349,9 @@ def render(events: Iterable[Dict]) -> str:
     if not events:
         return "(empty run log)"
     sections = [_header(events), _fit_section(events),
-                _search_section(events), _audit_bench_section(events),
-                _trace_section(events), _misc_section(events)]
+                _fault_section(events), _search_section(events),
+                _audit_bench_section(events), _trace_section(events),
+                _misc_section(events)]
     return "\n".join("\n".join(s) for s in sections if s)
 
 
@@ -423,4 +486,24 @@ def summarize(events: Iterable[Dict]) -> Dict:
                              "total_s": t.get("total_s"),
                              "dp_total_s": t.get("dp_total_s")}
                             for t in traces]
+    fault_kinds = ("fault", "rollback", "recovery", "data_fault",
+                   "ckpt_fallback", "thread_leak")
+    if any(kinds.get(k) for k in fault_kinds):
+        fa: Dict = {"counts": {k: kinds[k] for k in fault_kinds
+                               if kinds.get(k)}}
+        rollbacks = [e for e in events if e.get("kind") == "rollback"]
+        if rollbacks:
+            fa["rollbacks"] = [{"from_step": r.get("from_step"),
+                                "to_step": r.get("to_step")}
+                               for r in rollbacks]
+        fallbacks = [e for e in events if e.get("kind") == "ckpt_fallback"]
+        if fallbacks:
+            fa["ckpt_fallbacks"] = [{"from_step": c.get("from_step"),
+                                     "to_step": c.get("to_step")}
+                                    for c in fallbacks]
+        skips = [e for e in events if e.get("kind") == "data_fault"
+                 and e.get("action") == "skip"]
+        if skips:
+            fa["data_skips"] = len(skips)
+        out["faults"] = fa
     return out

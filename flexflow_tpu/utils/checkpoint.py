@@ -10,28 +10,59 @@ adds it as a first-class subsystem:
     parallelization;
   * atomic directory commit (write to ``<dir>/tmp.<step>``, fsync, rename to
     ``<dir>/step_<N>``) — a killed run never leaves a half-written
-    checkpoint that resume would trust;
+    checkpoint that resume would trust; stale ``tmp.<step>`` /
+    ``step_*.old`` directories a crash mid-save left behind are swept on
+    the next save/restore instead of accumulating forever;
+  * **verified integrity** (robustness round): ``meta.json`` records a
+    SHA-256 digest per payload file at save; :func:`verify_checkpoint`
+    re-checks them, and restore (without an explicit step) CASCADES
+    latest -> older past truncated/missing/corrupt steps, emitting a
+    ``ckpt_fallback`` obs record — a flipped bit in ``arrays.npz`` costs
+    one checkpoint interval, not the run;
+  * a **finiteness gate**: ``save_checkpoint`` refuses (by default) to
+    commit non-finite float leaves over good on-disk state
+    (:class:`NonFiniteCheckpointError`), and pruning never deletes the
+    newest step that still verifies clean — so a diverged run cannot
+    rotate every healthy checkpoint out of existence;
   * restore is **sharding-aware**: when given the model, every param lands
     directly on its op's NamedSharding (same placement as ``FFModel.init``),
     so resume does not funnel large trees through one device.
 
 Format: one ``arrays.npz`` of flattened ``a/b/c``-keyed leaves per tree,
-plus ``meta.json`` recording each leaf's dtype.  Plain numpy keeps the
-format dependency-free and inspectable; extension dtypes (bfloat16, fp8)
-round-trip by re-viewing the raw bytes as the recorded ml_dtypes dtype on
-load (np.savez alone degrades them to void).
+plus ``meta.json`` recording each leaf's dtype and the file digests.
+Plain numpy keeps the format dependency-free and inspectable; extension
+dtypes (bfloat16, fp8) round-trip by re-viewing the raw bytes as the
+recorded ml_dtypes dtype on load (np.savez alone degrades them to void).
+Pre-digest checkpoints load unchanged (verification reports them as
+unverifiable rather than corrupt).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 _SEP = "/"
+
+
+class CheckpointError(RuntimeError):
+    """Base of the checkpoint subsystem's own failures."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A requested checkpoint failed integrity verification (or every
+    candidate did, when cascading)."""
+
+
+class NonFiniteCheckpointError(CheckpointError):
+    """``save_checkpoint`` refused to commit non-finite float state over
+    good on-disk checkpoints (pass ``require_finite=False`` to force)."""
 
 
 def _flatten(tree: Dict, prefix: str = "") -> Dict[str, Any]:
@@ -68,12 +99,24 @@ def _list_steps(ckpt_dir: str) -> list:
         return []
     steps = []
     for name in os.listdir(ckpt_dir):
-        if name.startswith("step_"):
+        if name.startswith("step_") and not name.endswith(".old"):
             try:
                 steps.append(int(name[5:]))
             except ValueError:
                 continue
     return sorted(steps)
+
+
+def _sweep_stale(ckpt_dir: str) -> None:
+    """Remove leftovers of a crash mid-save: uncommitted ``tmp.<step>``
+    staging dirs and ``step_*.old`` aside copies.  They were previously
+    never cleaned up and accumulated forever."""
+    if not os.path.isdir(ckpt_dir):
+        return
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("tmp.") or (name.startswith("step_")
+                                       and name.endswith(".old")):
+            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
@@ -82,16 +125,75 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _nonfinite_leaves(arrays: Dict[str, np.ndarray]) -> list:
+    """Paths of float leaves holding NaN/Inf (int/bool leaves skipped;
+    extension floats like bfloat16 are checked through their float32
+    view when the ufunc lacks a native loop)."""
+    bad = []
+    for path, a in arrays.items():
+        if a.dtype.kind in "iub":
+            continue
+        try:
+            ok = bool(np.isfinite(a).all())
+        except TypeError:
+            ok = bool(np.isfinite(np.asarray(a, np.float32)).all())
+        if not ok:
+            bad.append(path)
+    return bad
+
+
+def verify_checkpoint(ckpt_dir: str, step: int) -> Tuple[bool, str]:
+    """Integrity check of one committed step: directory + ``meta.json``
+    present and parseable, every payload file present with a matching
+    SHA-256 digest.  Returns ``(ok, reason)``; pre-digest checkpoints
+    pass as ``"unverified (no digests)"`` for format compatibility."""
+    d = _step_dir(ckpt_dir, step)
+    if not os.path.isdir(d):
+        return False, "missing directory"
+    try:
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"meta.json unreadable: {e}"
+    if int(meta.get("step", -1)) != int(step):
+        return False, (f"meta.json names step {meta.get('step')!r}, "
+                       f"directory says {step}")
+    if not os.path.exists(os.path.join(d, "arrays.npz")):
+        return False, "arrays.npz missing"
+    digests = meta.get("digests")
+    if not digests:
+        return True, "unverified (no digests; pre-digest format)"
+    for name, want in digests.items():
+        p = os.path.join(d, name)
+        if not os.path.exists(p):
+            return False, f"{name} missing"
+        got = _file_sha256(p)
+        if got != want:
+            return False, f"{name} digest mismatch ({got[:12]} != {want[:12]})"
+    return True, "ok"
+
+
 def save_checkpoint(ckpt_dir: str, step: int, params: Dict, state: Dict,
-                    opt_state: Dict, strategy=None, keep: int = 3) -> str:
-    """Write checkpoint atomically; prune to the newest ``keep`` steps.
-    Returns the committed directory."""
+                    opt_state: Dict, strategy=None, keep: int = 3,
+                    require_finite: bool = True) -> str:
+    """Write checkpoint atomically; prune to the newest ``keep`` steps
+    (never deleting the newest step that still VERIFIES clean, so a
+    corrupted latest cannot rotate the last good state away).  With
+    ``require_finite`` (the default) non-finite float leaves abort the
+    save BEFORE anything touches disk.  Returns the committed
+    directory."""
     os.makedirs(ckpt_dir, exist_ok=True)
+    _sweep_stale(ckpt_dir)
     tmp = os.path.join(ckpt_dir, f"tmp.{step}")
     final = _step_dir(ckpt_dir, step)
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
 
     arrays: Dict[str, np.ndarray] = {}
     dtypes: Dict[str, str] = {}
@@ -101,13 +203,28 @@ def save_checkpoint(ckpt_dir: str, step: int, params: Dict, state: Dict,
             a = np.asarray(leaf)
             arrays[path] = a
             dtypes[path] = str(a.dtype)
-    np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+    if require_finite:
+        bad = _nonfinite_leaves(arrays)
+        if bad:
+            raise NonFiniteCheckpointError(
+                f"refusing to checkpoint non-finite state at step {step}: "
+                f"{len(bad)} leaves, e.g. {bad[:3]} (pass "
+                f"require_finite=False to force)")
 
-    meta = {"step": int(step), "format": 1, "dtypes": dtypes}
-    with open(os.path.join(tmp, "meta.json"), "w") as f:
-        json.dump(meta, f)
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
     if strategy is not None and len(strategy):
         strategy.save(os.path.join(tmp, "strategy.json"))
+    # per-file content digests, recorded in meta.json so restore can
+    # distinguish a torn/bit-flipped checkpoint from a good one
+    digests = {name: _file_sha256(os.path.join(tmp, name))
+               for name in sorted(os.listdir(tmp))}
+    meta = {"step": int(step), "format": 2, "dtypes": dtypes,
+            "digests": digests}
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(meta, f)
 
     # durable commit: flush file data, then the tmp dir entry, then rename,
     # then flush the parent so the rename itself is on disk
@@ -140,9 +257,34 @@ def save_checkpoint(ckpt_dir: str, step: int, params: Dict, state: Dict,
     if aside:
         shutil.rmtree(aside, ignore_errors=True)
 
+    # deterministic fault injection (utils/faultinject.py): damage the
+    # COMMITTED copy — a torn write / bit flip the digests must catch
+    from flexflow_tpu.utils import faultinject
+
+    inj = faultinject.get()
+    if inj.enabled:
+        ap = os.path.join(final, "arrays.npz")
+        if inj.fire("ckpt_truncate", site=final):
+            with open(ap, "r+b") as f:
+                f.truncate(max(os.path.getsize(ap) // 2, 1))
+        if inj.fire("ckpt_corrupt", site=final):
+            with open(ap, "r+b") as f:
+                f.seek(os.path.getsize(ap) // 2)
+                b = f.read(1)
+                f.seek(-1, os.SEEK_CUR)
+                f.write(bytes([b[0] ^ 0xFF]))
+
     if keep:
-        for s in _list_steps(ckpt_dir)[:-keep]:
-            shutil.rmtree(_step_dir(ckpt_dir, s), ignore_errors=True)
+        steps = _list_steps(ckpt_dir)
+        protect = set(steps[-keep:])
+        for s in reversed(steps):
+            ok, _ = verify_checkpoint(ckpt_dir, s)
+            if ok:
+                protect.add(s)  # the newest verified-good step survives
+                break
+        for s in steps:
+            if s not in protect:
+                shutil.rmtree(_step_dir(ckpt_dir, s), ignore_errors=True)
     return final
 
 
@@ -158,16 +300,9 @@ def _restore_dtype(arr: np.ndarray, stored: Optional[str]) -> np.ndarray:
     return arr.astype(stored)
 
 
-def restore_checkpoint(ckpt_dir: str, model=None,
-                       step: Optional[int] = None
-                       ) -> Tuple[int, Dict, Dict, Dict]:
-    """Load (step, params, state, opt_state).  With ``model`` given, params
-    and opt leaves are placed on the owning op's sharding and state on the
-    op's grid, exactly as ``FFModel.init`` would place them."""
-    if step is None:
-        step = latest_step(ckpt_dir)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
+def _load_step(ckpt_dir: str, step: int, model=None
+               ) -> Tuple[int, Dict, Dict, Dict]:
+    """Load one committed step (no verification, no cascade)."""
     d = _step_dir(ckpt_dir, step)
     with open(os.path.join(d, "meta.json")) as f:
         meta = json.load(f)
@@ -209,6 +344,62 @@ def restore_checkpoint(ckpt_dir: str, model=None,
         opt_state = place(opt_state)
         state = jax.tree.map(jax.device_put, state)
     return step, params, state, opt_state
+
+
+def restore_checkpoint(ckpt_dir: str, model=None,
+                       step: Optional[int] = None, verify: bool = True,
+                       olog=None) -> Tuple[int, Dict, Dict, Dict]:
+    """Load (step, params, state, opt_state).  With ``model`` given, params
+    and opt leaves are placed on the owning op's sharding and state on the
+    op's grid, exactly as ``FFModel.init`` would place them.
+
+    Without an explicit ``step`` the restore CASCADES: the latest step is
+    verified (digests, presence, parseability) and actually loaded; on any
+    failure the next-older step is tried, a ``ckpt_fallback`` obs record
+    is emitted on ``olog``, and only when EVERY committed step fails does
+    this raise :class:`CheckpointCorruptError`.  An explicit ``step`` is
+    verified but never cascaded (the caller asked for that one)."""
+    from flexflow_tpu import obs
+
+    olog = olog if olog is not None else obs.NULL
+    _sweep_stale(ckpt_dir)
+    if step is not None:
+        if verify:
+            ok, why = verify_checkpoint(ckpt_dir, step)
+            if not ok:
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} under {ckpt_dir!r} failed "
+                    f"verification: {why}")
+        return _load_step(ckpt_dir, step, model)
+    steps = _list_steps(ckpt_dir)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
+    newest = steps[-1]
+    failures = []
+    for s in reversed(steps):
+        if verify:
+            ok, why = verify_checkpoint(ckpt_dir, s)
+            if not ok:
+                failures.append((s, why))
+                continue
+        try:
+            out = _load_step(ckpt_dir, s, model)
+        except Exception as e:  # torn npz, bad json, ... -> next candidate
+            failures.append((s, f"load failed: {e}"))
+            continue
+        if s != newest:
+            olog.event("ckpt_fallback", dir=ckpt_dir, from_step=newest,
+                       to_step=s,
+                       skipped=[{"step": fs, "reason": fw}
+                                for fs, fw in failures])
+            warnings.warn(
+                f"checkpoint fallback: step {newest} -> {s} under "
+                f"{ckpt_dir!r} ({'; '.join(f'step {fs}: {fw}' for fs, fw in failures)})",
+                RuntimeWarning)
+        return out
+    raise CheckpointCorruptError(
+        f"every checkpoint under {ckpt_dir!r} failed verification/load: "
+        + "; ".join(f"step {fs}: {fw}" for fs, fw in failures))
 
 
 def load_strategy(ckpt_dir: str, step: Optional[int] = None):

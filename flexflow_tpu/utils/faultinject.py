@@ -1,0 +1,182 @@
+"""Deterministic fault injection — the test harness of the fault-tolerance
+layer (robustness round).
+
+``FFConfig.fault_spec`` names faults to fire at EXACT occurrence indices,
+so every recovery path in the runtime — step health guard rollback
+(model.py::fit), checkpoint restore cascade (utils/checkpoint.py),
+retrying data sources (data/hdf5.py, data/imagenet.py) — is exercised at
+reproducible points in tests and in ``make fault-smoke``.
+
+Grammar (comma-separated entries)::
+
+    <kind>@<at>            fire on occurrence <at>        loss_nan@120
+    <kind>@<at>x<times>    fire on <at> .. <at+times-1>   data_io@50x3
+
+Occurrences are counted per kind by the injector itself: every ``fire()``
+call at a site increments the kind's counter, so ``loss_nan@120`` means
+"the 120th training step of this run", ``data_io@50x3`` means "the 50th
+through 52nd read attempts" (each RETRY is a new attempt — ``x3`` with a
+4-attempt retry policy is a transient fault the retries absorb, a huge
+``x`` count is a permanent one that forces the skip path), and
+``ckpt_truncate@2`` means "the 2nd checkpoint save".  Counting attempts
+instead of wall positions is what makes recovery terminate: after a
+rollback the re-run steps consume FRESH occurrence indices, so a fault
+pinned at one index cannot re-fire forever.
+
+Kinds:
+
+  * ``loss_nan``      — fit() poisons that step's recorded loss with NaN
+                        (device-side; exercises the health guard);
+  * ``data_io``       — the data sources raise :class:`InjectedIOError`
+                        (an ``OSError``; exercises retry + skip budget);
+  * ``ckpt_truncate`` — save_checkpoint truncates the just-committed
+                        ``arrays.npz`` (a torn write; exercises digest
+                        verification + the restore cascade);
+  * ``ckpt_corrupt``  — save_checkpoint flips one byte of the committed
+                        ``arrays.npz`` (a bit flip; same recovery path).
+
+One injector is installed process-globally (``install``/``get``) so data
+sources running on background threads see the same schedule; ``fit()``
+installs from its config and restores the previous injector on exit.
+Every fired fault is emitted as a first-class ``fault`` obs record when
+the injector carries a sink.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+KINDS = ("loss_nan", "data_io", "ckpt_truncate", "ckpt_corrupt")
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``fault_spec`` string."""
+
+
+class InjectedIOError(OSError):
+    """A deterministically injected transient I/O failure (``data_io``) —
+    an ``OSError`` so the retry policies treat it exactly like a real
+    read error."""
+
+
+def parse_fault_spec(spec: str) -> Dict[str, List[Tuple[int, int]]]:
+    """``"loss_nan@120,data_io@50x3"`` -> ``{kind: [(at, times), ...]}``.
+    Raises :class:`FaultSpecError` on unknown kinds or bad syntax, so a
+    typo'd spec fails at config time instead of silently never firing."""
+    out: Dict[str, List[Tuple[int, int]]] = {}
+    for raw in (spec or "").split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        if "@" not in entry:
+            raise FaultSpecError(
+                f"fault spec entry {entry!r} needs '<kind>@<at>[x<times>]'")
+        kind, _, pos = entry.partition("@")
+        kind = kind.strip()
+        if kind not in KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r}; known: {', '.join(KINDS)}")
+        at_s, _, times_s = pos.partition("x")
+        try:
+            at = int(at_s)
+            times = int(times_s) if times_s else 1
+        except ValueError:
+            raise FaultSpecError(
+                f"fault spec entry {entry!r}: occurrence and repeat count "
+                f"must be integers") from None
+        if at < 1 or times < 1:
+            raise FaultSpecError(
+                f"fault spec entry {entry!r}: occurrence index and repeat "
+                f"count are 1-based and must be >= 1")
+        out.setdefault(kind, []).append((at, times))
+    return out
+
+
+class NullInjector:
+    """The disabled injector: ``fire()`` is always False and counts
+    nothing.  A single shared instance (``NULL``) is the default."""
+
+    enabled = False
+
+    def fire(self, kind: str, site: str = "") -> bool:
+        return False
+
+    def fired(self, kind: Optional[str] = None) -> int:
+        return 0
+
+
+NULL = NullInjector()
+
+
+class FaultInjector:
+    """Deterministic occurrence-counting injector for one run.  Thread-safe
+    (data sources fire from background threads)."""
+
+    enabled = True
+
+    def __init__(self, spec: str, olog=None):
+        self.spec = spec
+        self.ranges = parse_fault_spec(spec)
+        self.olog = olog
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._fired: List[Tuple[str, int, str]] = []
+
+    def fire(self, kind: str, site: str = "") -> bool:
+        """Count one occurrence of ``kind`` at ``site``; True when the
+        spec schedules a fault for this occurrence.  Emits a ``fault``
+        obs record (source="injected") for every fire."""
+        with self._lock:
+            n = self._counts.get(kind, 0) + 1
+            self._counts[kind] = n
+            hit = any(at <= n < at + times
+                      for at, times in self.ranges.get(kind, ()))
+            if hit:
+                self._fired.append((kind, n, site))
+        if hit and self.olog is not None:
+            self.olog.event("fault", source="injected", fault=kind,
+                            occurrence=n, site=site)
+        return hit
+
+    def fired(self, kind: Optional[str] = None) -> int:
+        """How many faults have actually fired (optionally of one kind)."""
+        with self._lock:
+            if kind is None:
+                return len(self._fired)
+            return sum(1 for k, _, _ in self._fired if k == kind)
+
+
+_current = NULL
+_install_lock = threading.Lock()
+
+
+def get():
+    """The process-global injector (``NULL`` unless a run installed one)."""
+    return _current
+
+
+def install(injector):
+    """Make ``injector`` the process-global one; returns the previous
+    injector so the installer can restore it (``fit()`` does, in a
+    ``finally``)."""
+    global _current
+    with _install_lock:
+        prev = _current
+        _current = injector if injector is not None else NULL
+        return prev
+
+
+def from_config(config, olog=None):
+    """A :class:`FaultInjector` for ``config.fault_spec``, or ``NULL``
+    when the spec is empty/absent — the one gate ``fit()`` calls."""
+    spec = getattr(config, "fault_spec", "") or ""
+    return FaultInjector(spec, olog=olog) if spec.strip() else NULL
+
+
+def raise_if(kind: str, site: str = "") -> None:
+    """Data-source hook: raise :class:`InjectedIOError` when the global
+    injector fires ``kind`` for this occurrence."""
+    inj = _current
+    if inj.enabled and inj.fire(kind, site=site):
+        raise InjectedIOError(f"injected {kind} fault at {site or '?'}")

@@ -1,0 +1,110 @@
+"""Step health guard: windowed finite-loss checks for the training loop.
+
+A single NaN loss used to propagate silently — the run kept training on
+diverged state, and the checkpoint pruner would happily overwrite the
+last healthy checkpoints with NaN parameters.  The guard closes that
+hole WITHOUT touching the device hot path: ``fit()`` accumulates per-step
+losses as raw device arrays (as it always has) and hands the guard the
+window since the last check only at boundaries that already host-sync —
+``print_freq`` prints, checkpoint saves, and the final step.  Zero
+per-step host syncs are added, and with finite losses the run is
+byte-identical to an unguarded one.
+
+Policies (``FFConfig.on_divergence``):
+
+  * ``halt``     — raise :class:`TrainingDiverged` (the default: fail
+                   fast and loud, never train on NaN state);
+  * ``warn``     — log + emit the ``fault`` record, keep training;
+  * ``rollback`` — tell ``fit()`` to restore the last VERIFIED
+                   checkpoint (utils/checkpoint.py cascade) and continue
+                   on fresh data; after ``max_rollbacks`` restores the
+                   guard raises anyway, so a deterministic NaN cannot
+                   loop forever.
+
+All detections flow through obs as first-class ``fault`` records
+(source="guard"); the first clean window after a rollback emits the
+matching ``recovery`` record.
+"""
+
+from __future__ import annotations
+
+import math
+
+POLICIES = ("halt", "warn", "rollback")
+
+
+class TrainingDiverged(RuntimeError):
+    """A non-finite loss under the ``halt`` policy, or divergence that
+    survived every allowed rollback."""
+
+    def __init__(self, step: int, value: float, rollbacks: int = 0):
+        self.step = step
+        self.value = value
+        self.rollbacks = rollbacks
+        extra = (f" after {rollbacks} rollback(s)" if rollbacks else "")
+        super().__init__(
+            f"training diverged: non-finite loss {value!r} at iteration "
+            f"{step}{extra}")
+
+
+class StepHealthGuard:
+    """One guard per ``fit()`` call.  ``check()`` is invoked only at
+    existing sync boundaries with the loss window accumulated since the
+    previous check."""
+
+    def __init__(self, policy: str = "halt", max_rollbacks: int = 3,
+                 olog=None, log=print):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"on_divergence must be one of {'|'.join(POLICIES)}, "
+                f"got {policy!r}")
+        from flexflow_tpu import obs
+
+        self.policy = policy
+        self.max_rollbacks = max(int(max_rollbacks), 0)
+        self.rollbacks = 0
+        self.olog = olog if olog is not None else obs.NULL
+        self.log = log
+        self._await_recovery = False
+
+    def check(self, window, first_step: int):
+        """Inspect the loss window (device or host scalars) covering
+        steps ``first_step .. first_step+len(window)-1``.  Returns None
+        (healthy), ``"warn"`` (diverged, policy says continue) or
+        ``"rollback"`` (caller must restore + rewind); raises
+        :class:`TrainingDiverged` under ``halt`` or when the rollback
+        budget is spent."""
+        if not window:
+            return None
+        import jax
+
+        vals = [float(v) for v in jax.device_get(list(window))]
+        bad = next((i for i, v in enumerate(vals)
+                    if not math.isfinite(v)), None)
+        if bad is None:
+            if self._await_recovery:
+                self._await_recovery = False
+                step = first_step + len(vals) - 1
+                self.olog.event("recovery", source="guard",
+                                after="rollback", step=step)
+                self.log(f"health guard: recovered — window through "
+                         f"iteration {step} is finite again")
+            return None
+        step = first_step + bad
+        value = vals[bad]
+        self.olog.event("fault", source="guard", fault="loss_divergence",
+                        step=step, value=value, policy=self.policy)
+        if self.policy == "warn":
+            self.log(f"warning: non-finite loss {value!r} at iteration "
+                     f"{step} (on_divergence=warn; continuing)")
+            return "warn"
+        if self.policy == "rollback":
+            if self.rollbacks >= self.max_rollbacks:
+                self.olog.event("fault", source="guard",
+                                fault="rollback_budget_exhausted",
+                                step=step, rollbacks=self.rollbacks)
+                raise TrainingDiverged(step, value, self.rollbacks)
+            self.rollbacks += 1
+            self._await_recovery = True
+            return "rollback"
+        raise TrainingDiverged(step, value)

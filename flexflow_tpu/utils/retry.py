@@ -1,0 +1,76 @@
+"""Bounded retry with exponential backoff and DETERMINISTIC jitter.
+
+The fault-tolerance layer (robustness round) wraps the I/O seams of the
+data subsystem — HDF5 chunk reads (data/hdf5.py) and ImageNet file decode
+(data/imagenet.py) — so one transient read error no longer kills a
+multi-hour run.  Two properties the tests pin:
+
+  * **bounded**: a :class:`RetryPolicy` caps total attempts; the LAST
+    failure re-raises unchanged (callers decide between skip / abort);
+  * **deterministic**: the jitter fraction is derived from
+    ``crc32(seed, attempt)`` — not ``random`` — so two runs of the same
+    failing schedule back off identically and the fault-injection
+    harness (utils/faultinject.py) replays bit-equal timelines.
+
+Only exception types in ``retry_on`` are retried (default ``OSError`` —
+the transient-I/O family, including the harness's ``InjectedIOError``);
+anything else propagates immediately as a genuine bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import zlib
+from typing import Callable, Optional, Tuple, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff schedule: attempt ``n`` (1-based count of FAILURES so far)
+    waits ``min(base_delay * multiplier**(n-1), max_delay)`` scaled by a
+    deterministic jitter factor in ``[1 - jitter, 1]``."""
+
+    attempts: int = 4          # total tries (1 initial + attempts-1 retries)
+    base_delay: float = 0.05
+    max_delay: float = 1.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+
+    def delay(self, failures: int) -> float:
+        d = min(self.base_delay * self.multiplier ** max(failures - 1, 0),
+                self.max_delay)
+        if self.jitter <= 0:
+            return d
+        frac = zlib.crc32(f"{self.seed}:{failures}".encode()) % 1000 / 1000.0
+        return d * (1.0 - self.jitter * frac)
+
+
+def call_with_retry(fn: Callable, policy: Optional[RetryPolicy] = None,
+                    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+                    on_retry: Optional[Callable] = None,
+                    on_recover: Optional[Callable] = None,
+                    sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn()`` under ``policy``.  ``on_retry(exc, failures, delay)``
+    fires before each backoff sleep; ``on_recover(failures)`` fires when a
+    call succeeds AFTER at least one failure (the data sources emit their
+    ``recovery`` obs record there).  The final failure re-raises the
+    original exception."""
+    policy = policy or RetryPolicy()
+    failures = 0
+    while True:
+        try:
+            out = fn()
+        except retry_on as e:
+            failures += 1
+            if failures >= policy.attempts:
+                raise
+            d = policy.delay(failures)
+            if on_retry is not None:
+                on_retry(e, failures, d)
+            sleep(d)
+            continue
+        if failures and on_recover is not None:
+            on_recover(failures)
+        return out

@@ -135,3 +135,145 @@ def test_periodic_checkpointing(tmp_path, machine8):
     steps = sorted(int(n[5:]) for n in os.listdir(str(tmp_path))
                    if n.startswith("step_"))
     assert 5 in steps and (2 in steps or 4 in steps)
+
+
+# ---------------------------------------------------------------------------
+# verified integrity (robustness round): digests, cascade, finiteness gate
+
+
+def _plain_trees():
+    params = {"op": {"w": np.arange(12.0, dtype=np.float32).reshape(3, 4),
+                     "b": np.zeros((4,), np.float32)}}
+    return params, {}, {"op": {"w": np.ones((3, 4), np.float32),
+                               "b": np.ones((4,), np.float32)}}
+
+
+def _step_path(tmp_path, step):
+    return tmp_path / f"step_{step:08d}"
+
+
+def test_digests_recorded_and_verified(tmp_path):
+    import json
+
+    p, s, o = _plain_trees()
+    d = ckpt.save_checkpoint(str(tmp_path), 1, p, s, o)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    assert "arrays.npz" in meta["digests"]
+    ok, why = ckpt.verify_checkpoint(str(tmp_path), 1)
+    assert ok, why
+    # flip one byte -> digest mismatch
+    ap = os.path.join(d, "arrays.npz")
+    raw = bytearray(open(ap, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(ap, "wb").write(bytes(raw))
+    ok, why = ckpt.verify_checkpoint(str(tmp_path), 1)
+    assert not ok and "digest mismatch" in why
+
+
+@pytest.mark.parametrize("damage", ["truncate", "rm_meta", "bad_digest"])
+def test_restore_cascades_to_prior_step(tmp_path, damage):
+    import json
+
+    p, s, o = _plain_trees()
+    ckpt.save_checkpoint(str(tmp_path), 1, p, s, o)
+    ckpt.save_checkpoint(str(tmp_path), 2, p, s, o)
+    d2 = str(_step_path(tmp_path, 2))
+    if damage == "truncate":
+        ap = os.path.join(d2, "arrays.npz")
+        with open(ap, "r+b") as f:
+            f.truncate(os.path.getsize(ap) // 2)
+    elif damage == "rm_meta":
+        os.remove(os.path.join(d2, "meta.json"))
+    else:
+        mp = os.path.join(d2, "meta.json")
+        with open(mp) as f:
+            meta = json.load(f)
+        meta["digests"]["arrays.npz"] = "0" * 64
+        with open(mp, "w") as f:
+            json.dump(meta, f)
+    from flexflow_tpu.obs import RunLog, read_events
+
+    ol = RunLog(str(tmp_path / "obs.jsonl"), run_id="cc")
+    with pytest.warns(RuntimeWarning, match="checkpoint fallback"):
+        step, p2, _, _ = ckpt.restore_checkpoint(str(tmp_path), olog=ol)
+    ol.close()
+    assert step == 1
+    np.testing.assert_array_equal(p2["op"]["w"], p["op"]["w"])
+    (fb,) = [e for e in read_events(ol.path)
+             if e["kind"] == "ckpt_fallback"]
+    assert fb["from_step"] == 2 and fb["to_step"] == 1
+    assert fb["skipped"] and fb["skipped"][0]["step"] == 2
+
+
+def test_restore_all_corrupt_raises(tmp_path):
+    p, s, o = _plain_trees()
+    ckpt.save_checkpoint(str(tmp_path), 1, p, s, o)
+    ap = os.path.join(str(_step_path(tmp_path, 1)), "arrays.npz")
+    with open(ap, "r+b") as f:
+        f.truncate(10)
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.restore_checkpoint(str(tmp_path))
+
+
+def test_restore_explicit_step_never_cascades(tmp_path):
+    p, s, o = _plain_trees()
+    ckpt.save_checkpoint(str(tmp_path), 1, p, s, o)
+    ckpt.save_checkpoint(str(tmp_path), 2, p, s, o)
+    os.remove(os.path.join(str(_step_path(tmp_path, 2)), "meta.json"))
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.restore_checkpoint(str(tmp_path), step=2)
+
+
+def test_nonfinite_save_refused(tmp_path):
+    p, s, o = _plain_trees()
+    ckpt.save_checkpoint(str(tmp_path), 1, p, s, o)
+    p["op"]["w"] = np.array([[np.nan, 1.0], [2.0, 3.0]], np.float32)
+    with pytest.raises(ckpt.NonFiniteCheckpointError):
+        ckpt.save_checkpoint(str(tmp_path), 2, p, s, o)
+    # nothing was committed, not even a tmp dir — step 1 stays latest
+    assert ckpt.latest_step(str(tmp_path)) == 1
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.startswith("tmp.")]
+    # explicit opt-out still commits (e.g. post-mortem state capture)
+    ckpt.save_checkpoint(str(tmp_path), 2, p, s, o, require_finite=False)
+    assert ckpt.latest_step(str(tmp_path)) == 2
+    # int leaves are never scanned as non-finite
+    ip = {"op": {"idx": np.array([1, 2], np.int32)}}
+    ckpt.save_checkpoint(str(tmp_path), 3, ip, {}, {})
+
+
+def test_prune_protects_newest_verified_step(tmp_path):
+    from flexflow_tpu.utils import faultinject
+
+    p, s, o = _plain_trees()
+    ckpt.save_checkpoint(str(tmp_path), 1, p, s, o, keep=1)
+    # the NEXT save is truncated post-commit (a torn write at the worst
+    # moment); keep=1 would normally delete step 1 — the verified-good
+    # protection must keep it
+    prev = faultinject.install(
+        faultinject.FaultInjector("ckpt_truncate@1"))
+    try:
+        ckpt.save_checkpoint(str(tmp_path), 2, p, s, o, keep=1)
+    finally:
+        faultinject.install(prev)
+    assert os.path.isdir(str(_step_path(tmp_path, 1))), \
+        "pruning must never delete the newest verified-good step"
+    ok, _ = ckpt.verify_checkpoint(str(tmp_path), 2)
+    assert not ok
+    with pytest.warns(RuntimeWarning, match="checkpoint fallback"):
+        step, p2, _, _ = ckpt.restore_checkpoint(str(tmp_path))
+    assert step == 1
+    np.testing.assert_array_equal(p2["op"]["w"], p["op"]["w"])
+
+
+def test_stale_tmp_and_old_dirs_swept(tmp_path):
+    p, s, o = _plain_trees()
+    (tmp_path / "tmp.7").mkdir()
+    (tmp_path / "tmp.7" / "junk").write_text("x")
+    (tmp_path / "step_00000009.old").mkdir()
+    ckpt.save_checkpoint(str(tmp_path), 1, p, s, o)
+    names = os.listdir(str(tmp_path))
+    assert "tmp.7" not in names and "step_00000009.old" not in names
+    # .old dirs are not listed as restorable steps either
+    assert ckpt.latest_step(str(tmp_path)) == 1

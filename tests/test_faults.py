@@ -1,0 +1,406 @@
+"""Fault-tolerance runtime tests (robustness round): bounded retry with
+deterministic jitter (utils/retry.py), the deterministic fault-injection
+harness (utils/faultinject.py), the step health guard's three policies
+(utils/health.py + model.py::fit), and the retrying/skipping data
+sources.  Tier-1: CPU, 8-device virtual mesh, no slow marker."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.data import synthetic_batches
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.obs import RunLog, read_events
+from flexflow_tpu.utils import faultinject
+from flexflow_tpu.utils.faultinject import (FaultInjector, FaultSpecError,
+                                            InjectedIOError,
+                                            parse_fault_spec)
+from flexflow_tpu.utils.health import TrainingDiverged
+from flexflow_tpu.utils.retry import RetryPolicy, call_with_retry
+
+
+def _model(machine, tmp=None, iters=6, print_freq=2, **kw):
+    cfg = FFConfig(batch_size=8, input_height=16, input_width=16,
+                   num_iterations=iters, print_freq=print_freq,
+                   num_classes=8, seed=7,
+                   ckpt_dir=str(tmp) if tmp else "", **kw)
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((8, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def _data(machine):
+    return synthetic_batches(machine, 8, 16, 16, num_classes=8,
+                             mode="random", seed=7)
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+
+
+def test_retry_policy_deterministic_and_bounded():
+    p = RetryPolicy(attempts=5, base_delay=0.1, max_delay=0.3, seed=1)
+    d1 = [p.delay(n) for n in range(1, 6)]
+    d2 = [RetryPolicy(attempts=5, base_delay=0.1, max_delay=0.3,
+                      seed=1).delay(n) for n in range(1, 6)]
+    assert d1 == d2, "jitter must be deterministic, not random"
+    assert all(0 < d <= 0.3 for d in d1)
+    # different seed -> different jitter
+    assert [RetryPolicy(seed=2, base_delay=0.1, max_delay=0.3).delay(n)
+            for n in range(1, 6)] != d1
+    # no jitter: pure exponential, capped
+    q = RetryPolicy(base_delay=0.1, max_delay=0.3, jitter=0.0)
+    assert [q.delay(n) for n in (1, 2, 3, 4)] == [0.1, 0.2, 0.3, 0.3]
+
+
+def test_call_with_retry_recovers_then_raises():
+    calls, retries, recovers = [], [], []
+
+    def flaky(fail_times):
+        def fn():
+            calls.append(1)
+            if len(calls) <= fail_times:
+                raise OSError(f"boom {len(calls)}")
+            return "ok"
+        return fn
+
+    out = call_with_retry(flaky(2), RetryPolicy(attempts=4),
+                          on_retry=lambda e, n, d: retries.append((n, d)),
+                          on_recover=recovers.append,
+                          sleep=lambda d: None)
+    assert out == "ok" and len(calls) == 3
+    assert [n for n, _ in retries] == [1, 2]
+    assert recovers == [2]
+    # attempts exhausted: the LAST failure re-raises unchanged
+    calls.clear()
+    with pytest.raises(OSError, match="boom 3"):
+        call_with_retry(flaky(99), RetryPolicy(attempts=3),
+                        sleep=lambda d: None)
+    assert len(calls) == 3
+    # non-retryable exception types propagate immediately
+    calls.clear()
+
+    def bug():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        call_with_retry(bug, RetryPolicy(attempts=5), sleep=lambda d: None)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# fault spec + injector
+
+
+def test_fault_spec_parse():
+    assert parse_fault_spec("loss_nan@120") == {"loss_nan": [(120, 1)]}
+    assert parse_fault_spec(" data_io@50x3 , ckpt_truncate@2") == {
+        "data_io": [(50, 3)], "ckpt_truncate": [(2, 1)]}
+    assert parse_fault_spec("") == {}
+    for bad in ("loss_nan", "nonsense@3", "loss_nan@0", "data_io@2x0",
+                "loss_nan@x"):
+        with pytest.raises(FaultSpecError):
+            parse_fault_spec(bad)
+
+
+def test_injector_occurrence_counting(tmp_path):
+    ol = RunLog(str(tmp_path / "inj.jsonl"), run_id="inj")
+    inj = FaultInjector("data_io@2x2", olog=ol)
+    assert [inj.fire("data_io") for _ in range(5)] == [
+        False, True, True, False, False]
+    assert inj.fired("data_io") == 2 and inj.fired() == 2
+    # other kinds count independently and never fire
+    assert not inj.fire("loss_nan")
+    ol.close()
+    evs = [e for e in read_events(ol.path) if e["kind"] == "fault"]
+    assert len(evs) == 2
+    assert all(e["source"] == "injected" and e["fault"] == "data_io"
+               for e in evs)
+    assert [e["occurrence"] for e in evs] == [2, 3]
+
+
+def test_raise_if_uses_global_injector():
+    prev = faultinject.install(FaultInjector("data_io@1"))
+    try:
+        with pytest.raises(InjectedIOError):
+            faultinject.raise_if("data_io", site="here")
+        faultinject.raise_if("data_io")  # occurrence 2: clean
+    finally:
+        faultinject.install(prev)
+    assert faultinject.get() is prev
+
+
+def test_flags_parsed():
+    cfg = FFConfig.from_args(["--on-divergence", "rollback",
+                              "--max-rollbacks", "1",
+                              "--fault-spec", "loss_nan@3,data_io@2x2",
+                              "--data-retry-attempts", "6",
+                              "--data-skip-budget", "9"])
+    assert cfg.on_divergence == "rollback" and cfg.max_rollbacks == 1
+    assert cfg.fault_spec == "loss_nan@3,data_io@2x2"
+    assert cfg.data_retry_attempts == 6 and cfg.data_skip_budget == 9
+    with pytest.raises(SystemExit):
+        FFConfig.from_args(["--on-divergence", "sometimes"])
+    with pytest.raises(SystemExit):
+        FFConfig.from_args(["--fault-spec", "bogus@3"])
+    from flexflow_tpu.apps.lm import parse_args as lm_args
+
+    lcfg = lm_args(["--on-divergence", "warn", "--fault-spec",
+                    "loss_nan@2", "--ckpt-dir", "/tmp/c", "--ckpt-freq",
+                    "4"])
+    assert lcfg.on_divergence == "warn" and lcfg.fault_spec == "loss_nan@2"
+    assert lcfg.ckpt_dir == "/tmp/c" and lcfg.ckpt_freq == 4
+    from flexflow_tpu.apps.nmt import parse_args as nmt_args
+
+    ncfg = nmt_args(["--on-divergence", "rollback", "--max-rollbacks",
+                     "2"])
+    assert ncfg.on_divergence == "rollback" and ncfg.max_rollbacks == 2
+
+
+# ---------------------------------------------------------------------------
+# step health guard (fit integration)
+
+
+def test_guard_halt_raises(tmp_path, machine8):
+    ff = _model(machine8, iters=4, fault_spec="loss_nan@2",
+                obs_dir=str(tmp_path), run_id="halt")
+    with pytest.raises(TrainingDiverged, match="iteration 2"):
+        ff.fit(_data(machine8), log=lambda *a: None)
+    evs = list(read_events(str(tmp_path / "halt.jsonl")))
+    (det,) = [e for e in evs if e["kind"] == "fault"
+              and e["source"] == "guard"]
+    assert det["fault"] == "loss_divergence" and det["step"] == 2
+    # the injector was uninstalled on the exception path
+    assert faultinject.get() is faultinject.NULL
+
+
+def test_guard_warn_continues(tmp_path, machine8):
+    ff = _model(machine8, iters=4, fault_spec="loss_nan@2",
+                on_divergence="warn", obs_dir=str(tmp_path), run_id="w")
+    logs = []
+    out = ff.fit(_data(machine8), log=logs.append)
+    assert len(out["loss"]) == 4 and out["rollbacks"] == 0
+    assert math.isnan(out["loss"][1])
+    assert math.isfinite(out["loss"][-1])
+    assert any("on_divergence=warn" in str(l) for l in logs)
+    evs = list(read_events(str(tmp_path / "w.jsonl")))
+    assert [e["kind"] for e in evs].count("rollback") == 0
+    assert any(e["kind"] == "fault" and e.get("source") == "guard"
+               for e in evs)
+
+
+def test_guard_rollback_restores_and_recovers(tmp_path, machine8):
+    ff = _model(machine8, tmp=tmp_path / "ckpt", iters=6, ckpt_freq=2,
+                fault_spec="loss_nan@5", on_divergence="rollback",
+                obs_dir=str(tmp_path), run_id="rb")
+    out = ff.fit(_data(machine8), log=lambda *a: None)
+    assert len(out["loss"]) == 6 and out["rollbacks"] == 1
+    assert all(math.isfinite(l) for l in out["loss"])
+    evs = list(read_events(str(tmp_path / "rb.jsonl")))
+    (rb,) = [e for e in evs if e["kind"] == "rollback"]
+    assert rb["from_step"] == 6 and rb["to_step"] == 4
+    (rec,) = [e for e in evs if e["kind"] == "recovery"]
+    assert rec["after"] == "rollback"
+    # order: injected fault -> guard detection -> rollback -> recovery
+    kinds = [(e["kind"], e.get("source")) for e in evs]
+    assert kinds.index(("fault", "injected")) \
+        < kinds.index(("fault", "guard")) \
+        < kinds.index(("rollback", None)) \
+        < kinds.index(("recovery", "guard"))
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    assert ckpt.latest_step(str(tmp_path / "ckpt")) == 6
+
+
+def test_guard_rollback_budget_bounded(tmp_path, machine8):
+    # a DETERMINISTIC divergence (fires on every re-run occurrence) must
+    # not rollback-loop forever
+    ff = _model(machine8, tmp=tmp_path / "ckpt", iters=6, ckpt_freq=2,
+                fault_spec="loss_nan@5x100", on_divergence="rollback",
+                max_rollbacks=2, obs_dir=str(tmp_path), run_id="budget")
+    with pytest.raises(TrainingDiverged, match="2 rollback"):
+        ff.fit(_data(machine8), log=lambda *a: None)
+    evs = list(read_events(str(tmp_path / "budget.jsonl")))
+    assert len([e for e in evs if e["kind"] == "rollback"]) == 2
+    assert any(e.get("fault") == "rollback_budget_exhausted" for e in evs)
+
+
+def test_guard_byte_inert_without_faults(machine8):
+    """Acceptance: with injection disabled the guarded fit is bit-equal
+    to the default run (and adds no behavior, whatever the policy)."""
+    a = _model(machine8, iters=4).fit(_data(machine8),
+                                      log=lambda *a_: None)
+    b = _model(machine8, iters=4, on_divergence="rollback",
+               max_rollbacks=5).fit(_data(machine8), log=lambda *a_: None)
+    assert a["loss"] == b["loss"]
+    assert b["rollbacks"] == 0
+
+
+def test_invalid_policy_raises(machine8):
+    ff = _model(machine8, iters=2, on_divergence="sometimes")
+    with pytest.raises(ValueError, match="on_divergence"):
+        ff.fit(_data(machine8), log=lambda *a: None)
+
+
+# ---------------------------------------------------------------------------
+# retrying data sources
+
+
+def _h5(tmp_path, n=16):
+    h5py = pytest.importorskip("h5py")
+    p = str(tmp_path / "d.h5")
+    with h5py.File(p, "w") as f:
+        f["images"] = np.zeros((n, 4, 4, 3), np.float32)
+        f["labels"] = np.arange(n, dtype=np.int32)
+    return p
+
+
+def test_hdf5_transient_fault_transparent(tmp_path, machine8):
+    from flexflow_tpu.data.hdf5 import hdf5_batches
+
+    p = _h5(tmp_path)
+    ol = RunLog(str(tmp_path / "h.jsonl"), run_id="h")
+    prev = faultinject.install(FaultInjector("data_io@2x2"))
+    try:
+        it = hdf5_batches(machine8, [p], batch_size=8, olog=ol,
+                          retry_attempts=4)
+        _, l0 = next(it)   # read attempt 1: clean
+        _, l1 = next(it)   # attempts 2,3 injected, 4 succeeds
+        it.close()
+    finally:
+        faultinject.install(prev)
+    ol.close()
+    # retries are TRANSPARENT: the stream is byte-identical to a clean run
+    assert l0.tolist() == list(range(8))
+    assert l1.tolist() == list(range(8, 16))
+    evs = list(read_events(ol.path))
+    retries = [e for e in evs if e["kind"] == "data_fault"
+               and e["action"] == "retry"]
+    assert len(retries) == 2
+    (rec,) = [e for e in evs if e["kind"] == "recovery"]
+    assert rec["source"] == "hdf5" and rec["failures"] == 2
+
+
+def test_hdf5_permanent_fault_skips_range(tmp_path, machine8):
+    from flexflow_tpu.data.hdf5 import hdf5_batches
+
+    p = _h5(tmp_path)
+    ol = RunLog(str(tmp_path / "s.jsonl"), run_id="s")
+    prev = faultinject.install(FaultInjector("data_io@1x2"))
+    try:
+        # attempts=2: read 1 fails twice -> permanent -> range skipped,
+        # cursor advances one batch, next read succeeds
+        it = hdf5_batches(machine8, [p], batch_size=8, olog=ol,
+                          retry_attempts=2, skip_budget=4)
+        _, lbl = next(it)
+        it.close()
+    finally:
+        faultinject.install(prev)
+    ol.close()
+    assert lbl.tolist() == list(range(8, 16))
+    evs = list(read_events(ol.path))
+    (skip,) = [e for e in evs if e["kind"] == "data_fault"
+               and e["action"] == "skip"]
+    assert skip["source"] == "hdf5" and skip["skips"] == 1
+
+
+def test_hdf5_skip_budget_exhausted(tmp_path, machine8):
+    from flexflow_tpu.data.hdf5 import hdf5_batches
+
+    p = _h5(tmp_path)
+    prev = faultinject.install(FaultInjector("data_io@1x1000"))
+    try:
+        it = hdf5_batches(machine8, [p], batch_size=8, retry_attempts=2,
+                          skip_budget=2)
+        with pytest.raises(RuntimeError, match="hdf5 prefetch thread"):
+            next(it)
+        it.close()
+    finally:
+        faultinject.install(prev)
+
+
+def test_imagenet_corrupt_sample_skipped(tmp_path, machine8):
+    from flexflow_tpu.data.imagenet import ImageDataset, image_batches
+
+    PIL = pytest.importorskip("PIL")  # noqa: F841
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = rng.randint(0, 255, size=(10, 12, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"img{i}.jpg", quality=95)
+    # one permanently corrupt file (not an injected fault — the real path)
+    (tmp_path / "train" / "cat" / "img1.jpg").write_bytes(b"not a jpeg")
+    ds = ImageDataset(str(tmp_path), "train")
+    ol = RunLog(str(tmp_path / "i.jsonl"), run_id="i")
+    it = image_batches(machine8, ds, batch_size=6, height=8, width=8,
+                       use_native=False, shuffle_seed=None, olog=ol,
+                       retry_attempts=2, skip_budget=4, place=False)
+    img, lbl = next(it)
+    ol.close()
+    assert img.shape == (6, 8, 8, 3)
+    assert np.all(np.isfinite(np.asarray(img)))
+    evs = list(read_events(ol.path))
+    (skip,) = [e for e in evs if e["kind"] == "data_fault"
+               and e["action"] == "skip"]
+    assert skip["source"] == "imagenet" and "img1.jpg" in skip["file"]
+    # budget: a dataset of ONLY corrupt files exhausts and raises
+    for f in (tmp_path / "train" / "dog").iterdir():
+        f.write_bytes(b"also broken")
+    for f in (tmp_path / "train" / "cat").iterdir():
+        f.write_bytes(b"also broken")
+    ds2 = ImageDataset(str(tmp_path), "train")
+    it2 = image_batches(machine8, ds2, batch_size=2, height=8, width=8,
+                        use_native=False, shuffle_seed=None,
+                        retry_attempts=2, skip_budget=3, place=False)
+    with pytest.raises(RuntimeError, match="skip budget"):
+        next(it2)
+
+
+def test_prefetch_leaked_join_detected(tmp_path, monkeypatch):
+    import threading
+
+    from flexflow_tpu.data import prefetch as pf
+
+    monkeypatch.setattr(pf, "_JOIN_TIMEOUT_S", 0.1)
+    release = threading.Event()
+
+    def stuck():
+        release.wait()  # a worker the stop event cannot unblock
+        yield None
+
+    ol = RunLog(str(tmp_path / "p.jsonl"), run_id="p")
+    p = pf.DevicePrefetcher(stuck(), machine=None, depth=1, olog=ol)
+    with pytest.warns(RuntimeWarning, match="did not exit"):
+        p.close()
+    assert p.leaked and p.summary()["leaked"]
+    ol.close()
+    (leak,) = [e for e in read_events(ol.path)
+               if e["kind"] == "thread_leak"]
+    assert leak["source"] == "DevicePrefetcher"
+    release.set()  # let the worker finish for real
+
+
+def test_resume_ahead_of_stream_clear_error(tmp_path, machine8):
+    ff = _model(machine8, tmp=tmp_path, iters=4, print_freq=0)
+    ff.fit(_data(machine8), log=lambda *a: None)
+    ff2 = _model(machine8, tmp=tmp_path, iters=6, print_freq=0)
+
+    def short_stream():
+        it = _data(machine8)
+        for _ in range(2):
+            yield next(it)
+
+    with pytest.raises(RuntimeError, match="ahead of the data stream"):
+        ff2.fit(short_stream(), log=lambda *a: None)

@@ -230,6 +230,41 @@ def test_fit_sim_drift_analytic_fallback(tmp_path, machine8):
     assert drift["predicted_s"] > 0 and drift["value"] > 0
 
 
+def test_fit_resume_emits_ckpt_fallback(tmp_path, machine8):
+    """Crash consistency end-to-end (robustness round): the latest
+    checkpoint is truncated on disk; a fresh fit() must cascade to the
+    prior step, emit a ckpt_fallback record, and resume training."""
+    import os
+
+    ckdir = str(tmp_path / "ckpt")
+    cfg = _cfg(tmp_path, run_id="fb1", ckpt_dir=ckdir, ckpt_freq=2)
+    ff = _small_model(machine8, cfg)
+    data = synthetic_batches(machine8, 8, 16, 16, num_classes=8,
+                             mode="ones")
+    ff.fit(data, num_iterations=4, log=lambda *a: None)
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    assert ckpt.latest_step(ckdir) == 4
+    ap = os.path.join(ckdir, "step_00000004", "arrays.npz")
+    with open(ap, "r+b") as f:  # torn write on the latest step
+        f.truncate(os.path.getsize(ap) // 2)
+
+    cfg2 = _cfg(tmp_path, run_id="fb2", ckpt_dir=ckdir, ckpt_freq=2)
+    ff2 = _small_model(machine8, cfg2)
+    data2 = synthetic_batches(machine8, 8, 16, 16, num_classes=8,
+                              mode="ones")
+    with pytest.warns(RuntimeWarning, match="checkpoint fallback"):
+        out = ff2.fit(data2, num_iterations=6, log=lambda *a: None)
+    evs = list(read_events(out["obs_path"]))
+    (fb,) = [e for e in evs if e["kind"] == "ckpt_fallback"]
+    assert fb["from_step"] == 4 and fb["to_step"] == 2
+    (res,) = [e for e in evs if e["kind"] == "checkpoint_restore"]
+    assert res["step"] == 2
+    # the run resumed from step 2 and completed the remaining 4 iters
+    assert len(out["loss"]) == 4
+    assert ckpt.latest_step(ckdir) == 6
+
+
 # ---------------------------------------------------------------------------
 # search surface
 
