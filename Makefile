@@ -1,7 +1,7 @@
 # Repo-level entry points; the native build lives in flexflow_tpu/native.
 PYTHON ?= python
 
-.PHONY: native check trace-smoke test bench-smoke fault-smoke
+.PHONY: native check trace-smoke test bench-smoke fault-smoke budget-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -28,8 +28,9 @@ bench-smoke:
 	BENCH_WINDOWS=1 BENCH_DTYPE=float32 $(PYTHON) bench.py \
 	| $(PYTHON) -c "import json,sys; rec=json.loads(sys.stdin.readline()); \
 	assert 'regrid_hops' in rec and 'input_stall_s' in rec, rec; \
+	assert 'comm_frac' in rec and 'stall_frac' in rec, rec; \
 	print('bench-smoke ok:', {k: rec[k] for k in \
-	('value','regrid_hops','input_stall_s')})"
+	('value','regrid_hops','input_stall_s','comm_frac','stall_frac')})"
 
 # deterministic fault-injection smoke (robustness round): loss_nan +
 # data_io injected into a tiny HDF5-fed run with --on-divergence
@@ -38,3 +39,11 @@ bench-smoke:
 # on a healthy run
 fault-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m flexflow_tpu.apps.fault_smoke
+
+# MFU-waterfall smoke (observability): tiny CNN with sampled op timing +
+# live metrics export; asserts the step_budget bucket invariant, a
+# rendered waterfall from the fresh obs dir, finite mfu/throughput
+# gauges in the Prometheus textfile, and validated Perfetto counter
+# lanes
+budget-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m flexflow_tpu.apps.budget_smoke

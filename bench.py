@@ -100,6 +100,27 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
         float(loss)
         samples.append(time.perf_counter() - t0)
     extras = {"input_stall_s": round(data.stall_s - stall0, 6)}
+    # top-level budget shares (MFU-waterfall round): how much of the
+    # timed windows went to input stall (measured), and the simulator's
+    # collective share for the benched assignment (the paper's per-op
+    # cost model — labeled sim-derived by construction)
+    total_timed = sum(samples)
+    extras["stall_frac"] = round(extras["input_stall_s"] / total_timed, 6) \
+        if total_timed > 0 else 0.0
+    extras["comm_frac"] = 0.0
+    try:
+        from flexflow_tpu.sim.search import StrategySearch
+
+        ss = StrategySearch(ff, machine=machine)
+        asn = ss.assignment_for(cfg.strategies) if cfg.strategies \
+            else ss.dp_assignment()
+        sim_total = ss.simulate(asn)
+        if sim_total > 0:
+            extras["comm_frac"] = round(
+                sum(r["collective_s"]
+                    for r in ss.cost_breakdown(asn)) / sim_total, 6)
+    except Exception as e:
+        print(f"comm_frac unavailable: {e}", file=sys.stderr)
     data.close()
     try:
         rsum = ff.regrid_plan_summary()
@@ -134,6 +155,33 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
         rl = compiled_roofline(compiled, elapsed / iters,
                                n_devices=machine.num_devices)
         mfu = rl.get("mxu_utilization")
+        # the roofline ceiling (the honest MFU upper bound of THIS
+        # compiled program) and the step's HBM footprint — runtime peak
+        # when the backend reports it, else the compiled memory analysis
+        # (arguments + outputs - aliased + temporaries)
+        from flexflow_tpu.sim.cost_model import TpuChipPerf
+
+        perf = TpuChipPerf()
+        peak = perf.peak_flops * machine.num_devices
+        hbm_bw = perf.hbm_bandwidth * machine.num_devices
+        flops, bytes_ = rl["flops"], rl["bytes_accessed"]
+        floor = max(flops / peak, bytes_ / hbm_bw)
+        if flops > 0 and floor > 0:
+            extras["mfu_ceiling"] = round(flops / floor / peak, 4)
+        hbm_peak = None
+        try:
+            stats = machine.devices[0].memory_stats() or {}
+            hbm_peak = stats.get("peak_bytes_in_use")
+        except Exception:
+            pass
+        if hbm_peak is None:
+            mem = compiled.memory_analysis()
+            hbm_peak = (getattr(mem, "argument_size_in_bytes", 0)
+                        + getattr(mem, "output_size_in_bytes", 0)
+                        - getattr(mem, "alias_size_in_bytes", 0)
+                        + getattr(mem, "temp_size_in_bytes", 0))
+        if hbm_peak:
+            extras["hbm_peak_gb"] = round(hbm_peak / 1e9, 4)
     except Exception:
         pass  # cost analysis unavailable on some backends: omit MFU
     return per_chip, tput, elapsed, mfu, spread, extras

@@ -32,6 +32,10 @@ refits the two knob families the simulator already exposes:
     does not explain is attributed to communication and folded into
     ``dcn_bandwidth``/``dcn_latency`` — the exact keys
     ``Topology.from_calibration`` reads (clamped to 10x either way).
+    When the stream carries a ``step_budget`` record (obs/budget.py),
+    its input-stall / host-sync / checkpoint buckets are subtracted
+    first, so non-communication overheads stop polluting the comm
+    constants (compute-only anchors).
 
     python -m flexflow_tpu.apps.calibrate --from-obs runs/ -o recal.json
 """
@@ -47,7 +51,7 @@ import time
 def _real_cnn_step(model: str, batch: int, dtype: str):
     import bench  # repo-root bench.py — the timed-loop protocol lives there
 
-    per_chip, tput, elapsed, _, _ = bench.run(
+    per_chip, tput, elapsed, _, _, _ = bench.run(
         model=model, batch_size=batch, dtype=dtype, compile_cache=True,
         windows=3)  # calibration wants a stable point, not the full spread
     return batch / tput  # seconds per step (tput is machine-wide)
@@ -191,10 +195,27 @@ def calibrate_from_obs(obs_dir: str, out: str = "", log=print) -> dict:
     # communication budget the run actually paid; its ratio to the
     # simulated collective seconds rescales the DCN constants.  Clamped —
     # a residual outside 10x means the attribution itself is suspect.
+    #
+    # Compute-only discipline (MFU-waterfall round): when the stream
+    # carries a ``step_budget`` record, the non-communication overheads
+    # it already attributed — input stall, host-sync boundaries,
+    # checkpoint I/O — are subtracted from the measured step BEFORE the
+    # residual is blamed on collectives, so a stalled input pipeline or
+    # a chatty checkpoint cadence no longer masquerades as slow DCN and
+    # pollutes the comm constants.
     comm_scale = None
     breakdowns = [e for e in events if e.get("kind") == "search_breakdown"]
+    budgets = [e for e in events if e.get("kind") == "step_budget"]
     measured_step = _median([float(d["measured_s"]) for d in drifts
                              if d.get("measured_s")])
+    budget_excluded = {}
+    if budgets:
+        bk = budgets[-1].get("buckets") or {}
+        budget_excluded = {
+            k: float(bk.get(k, 0.0) or 0.0)
+            for k in ("input_stall", "host_sync", "checkpoint")
+            if bk.get(k)}
+    excluded_s = sum(budget_excluded.values())
     if breakdowns and measured_step:
         bd = breakdowns[-1]
         anchored_compute = sum(
@@ -204,7 +225,7 @@ def calibrate_from_obs(obs_dir: str, out: str = "", log=print) -> dict:
         sim_comm = sum(float(r.get("collective_s", 0.0))
                        for r in bd.get("ops", []))
         opt_s = float(bd.get("opt_stream_s", 0.0))
-        residual = measured_step - anchored_compute - opt_s
+        residual = measured_step - anchored_compute - opt_s - excluded_s
         if sim_comm > 0 and residual > 0:
             comm_scale = min(max(residual / sim_comm, 0.1), 10.0)
     base_topo = Topology()
@@ -222,9 +243,18 @@ def calibrate_from_obs(obs_dir: str, out: str = "", log=print) -> dict:
         "collective_scale": round(comm_scale, 4) if comm_scale else None,
         "dcn_bandwidth": base_topo.dcn_bandwidth / (comm_scale or 1.0),
         "dcn_latency": base_topo.dcn_latency * (comm_scale or 1.0),
+        # the step_budget buckets excluded from the collective residual
+        # (compute-only discipline); empty = no budget record, legacy fit
+        "budget_excluded": {k: round(v, 6)
+                            for k, v in budget_excluded.items()},
+        "budget_excluded_s": round(excluded_s, 6),
     }
     for k, v in anchors.items():
         log(f"anchor {k}: x{v} (n={len(by_kind[k])})")
+    if excluded_s:
+        log(f"step_budget exclusions: {excluded_s * 1e3:.3f} ms/step "
+            f"({', '.join(sorted(budget_excluded))}) kept out of the "
+            f"collective residual")
     if comm_scale:
         log(f"collective residual scale: x{comm_scale:.3f} -> "
             f"dcn_bandwidth {payload['dcn_bandwidth']:.3e} B/s")

@@ -68,6 +68,10 @@ def parse_args(argv) -> RnnConfig:
             cfg.obs_dir = val()
         elif a in ("-run-id", "--run-id"):
             cfg.run_id = val()
+        elif a in ("-op-time-every", "--op-time-every"):
+            cfg.op_time_every = int(val())
+        elif a in ("-metrics-path", "--metrics-path"):
+            cfg.metrics_path = val()
         elif a in ("-regrid-planner", "--regrid-planner"):
             cfg.regrid_planner = val()
         elif a in ("-prefetch-depth", "--prefetch-depth"):

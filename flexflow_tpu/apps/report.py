@@ -1,8 +1,10 @@
 """Run-telemetry report CLI — the reader for the obs record schema.
 
-    python -m flexflow_tpu.apps.report <run.jsonl> [more.jsonl ...] [--json]
+    python -m flexflow_tpu.apps.report <run.jsonl|obs_dir ...> [--json]
     python -m flexflow_tpu.apps.report trace <run.jsonl|x.trace.json ...> \\
         [-o DIR] [--json]
+    python -m flexflow_tpu.apps.report budget <run.jsonl|obs_dir ...> \\
+        [--json]
 
 Default mode renders a run's JSONL event stream (FFConfig.obs_dir /
 RunLog output, a search-trace artifact, or a bench log) into the summary
@@ -26,6 +28,15 @@ contribution, and writes both ``<DIR>/drift_attribution.json`` and a
 merged ``<DIR>/merged.trace.json`` with sim lanes next to real lanes —
 loadable in ui.perfetto.dev.  ``apps/calibrate.py --from-obs`` consumes
 the same records to refit the cost model.
+
+The ``budget`` subcommand renders the **MFU waterfall** (obs/budget.py):
+a run's ``step_budget`` record — one step's wall time decomposed into
+compute / comm / input-stall / host-sync / checkpoint / residual buckets
+— joined with the compile record's post-fusion FLOPs/bytes and the chip
+roofline, printed as achieved MFU -> bucket-by-bucket recovery -> the
+roofline ceiling, largest lever first.  A bare directory argument (to any
+mode) expands to every ``*.jsonl`` stream inside it, so
+``report budget <obs_dir>`` works on a fresh obs dir directly.
 """
 
 from __future__ import annotations
@@ -35,14 +46,40 @@ import os
 import sys
 
 
+def _expand_dirs(paths, log):
+    """Directory arguments expand to the ``*.jsonl`` streams inside them
+    (rotated parts ride along via run_files), so a whole obs dir can be
+    rendered without globbing."""
+    import re
+
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(
+                os.path.join(p, fn) for fn in os.listdir(p)
+                if fn.endswith(".jsonl"))
+            if not found:
+                # rotated-only streams: point at each base-numbered part
+                found = sorted(
+                    os.path.join(p, fn) for fn in os.listdir(p)
+                    if re.search(r"\.jsonl\.\d+$", fn))
+            if not found:
+                log(f"warning: no *.jsonl streams under {p}")
+            out.extend(found)
+        else:
+            out.append(p)
+    return out
+
+
 def _read_paths(paths, log):
     """Events of every given stream: JSONL runs (rotated parts walked via
     run_files) merged with the events of Chrome trace JSON files.
+    Directories expand to their ``*.jsonl`` streams.
     Returns (obs_events, chrome_events)."""
     from flexflow_tpu.obs import read_events, run_files
 
     obs_events, chrome_events = [], []
-    for p in paths:
+    for p in _expand_dirs(paths, log):
         if p.endswith(".json"):
             try:
                 from flexflow_tpu.obs.trace import trace_events_from_file
@@ -152,10 +189,44 @@ def trace_main(argv, log=print) -> int:
     return 0
 
 
+def budget_main(argv, log=print) -> int:
+    """The MFU-waterfall pass (``report budget``): join the stream's
+    ``step_budget`` record with its compile-record FLOPs/bytes and the
+    chip roofline, render largest-lever-first."""
+    from flexflow_tpu.obs.budget import (check_budget, mfu_waterfall,
+                                         render_waterfall)
+
+    json_out = "--json" in argv
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        log(__doc__.strip())
+        return 2
+    events, _ = _read_paths(paths, log)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    wf = mfu_waterfall(events)
+    if wf is None:
+        log("no step_budget record in the stream(s): run fit() with "
+            "-obs-dir set (add --op-time-every N for sampled-step "
+            "decomposition and --metrics-path for live gauges)")
+        return 1
+    violations = check_budget({"step_wall_s": wf["step_wall_s"],
+                               "buckets": wf["buckets"]})
+    if json_out:
+        log(json.dumps({"waterfall": wf, "violations": violations}))
+        return 0 if not violations else 1
+    log("\n".join(render_waterfall(wf)))
+    if violations:
+        log("BUDGET INVARIANT VIOLATED: " + "; ".join(violations))
+        return 1
+    return 0
+
+
 def main(argv=None, log=print) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "trace":
         return trace_main(argv[1:], log)
+    if argv and argv[0] == "budget":
+        return budget_main(argv[1:], log)
     json_out = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:

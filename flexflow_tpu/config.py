@@ -73,6 +73,12 @@ class FFConfig:
     # size cap of one obs JSONL file before rollover to a numbered
     # sibling (<run>.jsonl.1, .2, ...); 0 = never rotate
     obs_max_bytes: int = 64 * 1024 * 1024
+    # always-on live metrics export (obs/metrics.py): when set, fit()
+    # atomically rewrites a Prometheus textfile at this path (plus a
+    # <path>.json snapshot) at its existing host-sync boundaries —
+    # throughput, MFU, HBM peak/live bytes, rollback/fault counters,
+    # prefetch stall.  Independent of obs_dir; empty = disabled.
+    metrics_path: str = ""
     # sampled per-op timing in fit() (obs/trace.py's measured side): every
     # Nth step the run syncs and times forward/backward/optimizer
     # sections (plus jax.profiler annotations), and isolated per-op shard
@@ -177,6 +183,8 @@ class FFConfig:
                 cfg.obs_max_bytes = int(val())
             elif a in ("-op-time-every", "--op-time-every"):
                 cfg.op_time_every = int(val())
+            elif a in ("-metrics-path", "--metrics-path"):
+                cfg.metrics_path = val()
             elif a in ("-chains", "--chains"):
                 cfg.search_chains = int(val())
             elif a in ("-delta", "--delta"):

@@ -1351,12 +1351,26 @@ class FFModel:
         # ONE bulk transfer after the timed loop (no per-step sync, and
         # callers get plain numbers instead of pinned device buffers)
         losses = []
+        # always-on live metrics (obs/metrics.py): gauges atomically
+        # rewritten at the SAME host-sync boundaries the guard rides —
+        # no new syncs, and independent of the obs JSONL being enabled
+        from flexflow_tpu.obs import metrics as obs_metrics
+
+        metrics = obs_metrics.from_config(
+            self.config, meta={"model": type(self).__name__,
+                               "run": olog.run_id or ""})
+        # step-budget accounting (obs/budget.py): host time this run
+        # spends on sync boundaries and checkpoint I/O, amortized into
+        # the post-loop step_budget record.  Timing existing code only.
+        host_sync_s = 0.0
+        ckpt_io_s = 0.0
+        fault_count = 0
         # obs: host-side per-step wall clock only — tick() never syncs,
         # and the per-step records are written AFTER the timed loop, so
         # the device pipeline is unperturbed.  Disabled: clock is None
         # and the loop pays one predicate check.
         clock = None
-        if olog.enabled:
+        if olog.enabled or metrics is not None:
             from flexflow_tpu.utils.profiling import StepClock
 
             clock = StepClock()
@@ -1406,11 +1420,15 @@ class FFModel:
                     and it1 % ckpt_freq == 0 and it1 < num_iterations
                 if at_print or at_ckpt or it1 == num_iterations:
                     # guard check rides boundaries that host-sync anyway
-                    # (print's float(loss), the save's device_get)
+                    # (print's float(loss), the save's device_get); the
+                    # boundary's own host time feeds the step_budget
+                    # host_sync bucket — timing existing work, not adding
+                    tb0 = time.perf_counter()
                     action = guard.check(
                         losses[window_start - loss_base:],
                         first_step=window_start + 1)
                     if action == "rollback":
+                        host_sync_s += time.perf_counter() - tb0
                         rstep, params, state, opt_state = \
                             self._rollback_restore(ckpt_dir, olog, log, it1)
                         del losses[max(rstep - loss_base, 0):]
@@ -1422,25 +1440,37 @@ class FFModel:
                         it = rstep
                         continue
                     window_start = it1
+                    host_sync_s += time.perf_counter() - tb0
                 if at_print:
+                    tb0 = time.perf_counter()
                     log(f"iter {it1}: loss = {float(loss):.4f}")
+                    host_sync_s += time.perf_counter() - tb0
                 if at_ckpt:
                     t0 = time.perf_counter()
                     try:
                         ckpt.save_checkpoint(ckpt_dir, it1, params, state,
                                              opt_state,
                                              self.config.strategies)
+                        dt = time.perf_counter() - t0
+                        ckpt_io_s += dt
                         olog.event("checkpoint_save", step=it1,
-                                   seconds=time.perf_counter() - t0,
-                                   dir=ckpt_dir)
+                                   seconds=dt, dir=ckpt_dir)
                     except ckpt.NonFiniteCheckpointError as e:
                         # never commit non-finite state over good
                         # checkpoints; the guard decides the run's fate
+                        fault_count += 1
+                        ckpt_io_s += time.perf_counter() - t0
                         olog.event("fault", source="checkpoint",
                                    fault="nonfinite_state", step=it1,
                                    error=str(e))
                         log(f"warning: skipped checkpoint at iteration "
                             f"{it1}: {e}")
+                if metrics is not None and (at_print or at_ckpt):
+                    # refresh the scrape at a boundary that just synced
+                    self._metrics_update(
+                        metrics, olog, step, params, state, opt_state,
+                        batch, losses, it1, warmup, start, guard,
+                        prefetcher, fault_count)
                 it += 1
             if loss is not None:
                 float(loss)
@@ -1468,12 +1498,26 @@ class FFModel:
         throughput = (n_timed * self.config.batch_size / elapsed
                       if elapsed > 0 and n_timed > 0 else 0.0)
         log(f"time = {elapsed:.4f}s, tp = {throughput:.2f} images/s")
+        if metrics is not None:
+            # final scrape with the settled end-of-run numbers (also the
+            # ONLY write for runs whose print/ckpt frequency never fired)
+            self._metrics_update(metrics, olog, step, params, state,
+                                 opt_state, batch if losses else None,
+                                 losses, num_iterations, warmup, start,
+                                 guard, prefetcher, fault_count,
+                                 elapsed=elapsed, throughput=throughput)
         if olog.enabled:
+            budget_totals = {
+                "host_sync_s": host_sync_s, "checkpoint_s": ckpt_io_s,
+                "input_stall_s": prefetcher.stall_s if prefetcher else 0.0,
+                "input_batches": prefetcher.batches if prefetcher else 0,
+                "steps": num_iterations - start_iter,
+            }
             self._emit_fit_records(olog, clock, losses, start_iter, warmup,
                                    num_iterations, elapsed, throughput,
                                    step, params, state, opt_state,
                                    batch if losses else None, op_samples,
-                                   sample_every)
+                                   sample_every, budget_totals)
             # execution-performance records (round 6): the regrid plan's
             # coalescing accounting and the prefetch stall residual —
             # both strictly post-loop, like every other fit record
@@ -1517,6 +1561,7 @@ class FFModel:
             "input_stall_s": prefetcher.stall_s if prefetcher else 0.0,
             "rollbacks": guard.rollbacks,
             "run_id": olog.run_id, "obs_path": olog.path,
+            "metrics_path": metrics.path if metrics is not None else "",
         }
 
     def _rollback_restore(self, ckpt_dir, olog, log, from_step):
@@ -1632,6 +1677,7 @@ class FFModel:
         from flexflow_tpu.utils.profiling import time_op_shard
 
         analytic = AnalyticCostModel()
+        rows = []
         for op in self.layers:
             t = time_op_shard(op, op.pc,
                               dtype=self.config.compute_dtype)
@@ -1641,15 +1687,201 @@ class FFModel:
             olog.event("op_time", scope="op", op=op.name,
                        op_kind=type(op).__name__, grid=list(op.pc.dims),
                        seconds=t, measured=measured)
+            rows.append({"op": op.name, "seconds": float(t),
+                         "measured": measured})
+        return rows
+
+    def _compiled_cost_stats(self, cache, step, params, state, opt_state,
+                             batch):
+        """Memoized compiled-step stats for the live gauges: post-fusion
+        FLOPs / bytes (XLA cost analysis) and an HBM-footprint estimate
+        from ``memory_analysis()`` (arguments + outputs − aliased +
+        temporaries).  Lowering hits jit's trace/compile caches — one
+        cheap call at the first boundary, then served from ``cache``."""
+        if "cost" in cache:
+            return cache["cost"]
+        cost = {}
+        if batch is not None:
+            try:
+                from flexflow_tpu.utils.profiling import \
+                    normalize_cost_analysis
+
+                compiled = step.lower(params, state, opt_state,
+                                      *batch).compile()
+                ca = normalize_cost_analysis(compiled)
+                cost["flops"] = float(ca.get("flops", 0.0))
+                cost["bytes"] = float(ca.get("bytes accessed", 0.0))
+                mem = compiled.memory_analysis()
+                live = (getattr(mem, "argument_size_in_bytes", 0)
+                        + getattr(mem, "output_size_in_bytes", 0)
+                        - getattr(mem, "alias_size_in_bytes", 0)
+                        + getattr(mem, "temp_size_in_bytes", 0))
+                if live > 0:
+                    cost["hbm_est"] = float(live)
+            except Exception:  # cost analysis is backend-optional
+                pass
+        cache["cost"] = cost
+        return cost
+
+    def _metrics_update(self, metrics, olog, step, params, state,
+                        opt_state, batch, losses, it1, warmup, start_t,
+                        guard, prefetcher, fault_count, elapsed=None,
+                        throughput=None):
+        """Refresh and publish the live gauges (obs/metrics.py) at a
+        boundary that already host-synced.  Every input is host-resident
+        or memoized; the one potentially non-trivial call (compiled cost
+        analysis) runs once per fit and is served from the exporter's
+        cache afterwards."""
+        from flexflow_tpu.sim.cost_model import TpuChipPerf
+
+        cost = self._compiled_cost_stats(metrics.cache, step, params,
+                                         state, opt_state, batch)
+        n_timed = it1 - warmup
+        if elapsed is None:
+            elapsed = time.perf_counter() - start_t
+        if throughput is None:
+            throughput = (n_timed * self.config.batch_size / elapsed
+                          if n_timed > 0 and elapsed > 0 else None)
+        step_s = (elapsed / n_timed if n_timed > 0 and elapsed > 0
+                  else None)
+        perf = TpuChipPerf()
+        peak = perf.peak_flops * max(self.machine.num_devices, 1)
+        hbm_bw = perf.hbm_bandwidth * max(self.machine.num_devices, 1)
+        mfu = mfu_ceiling = None
+        flops = cost.get("flops")
+        if flops and step_s:
+            mfu = flops / step_s / peak
+            floor = max(flops / peak, cost.get("bytes", 0.0) / hbm_bw)
+            if floor > 0:
+                mfu_ceiling = flops / floor / peak
+        hbm_live = hbm_peak = None
+        try:  # runtime device memory stats (TPU/GPU; None on CPU)
+            stats = self.machine.devices[0].memory_stats() or {}
+            hbm_live = stats.get("bytes_in_use")
+            hbm_peak = stats.get("peak_bytes_in_use")
+        except Exception:
+            pass
+        if hbm_peak is None:
+            hbm_peak = cost.get("hbm_est")
+        last_loss = None
+        if losses:
+            try:  # boundary already synced; float() is a cheap copy
+                last_loss = float(losses[-1])
+            except (TypeError, ValueError):
+                pass
+        metrics.update(
+            throughput_items_per_sec=throughput,
+            images_per_sec=throughput,
+            mfu=mfu, mfu_ceiling=mfu_ceiling,
+            step_wall_seconds=step_s, loss=last_loss,
+            steps_total=it1,
+            hbm_peak_bytes=hbm_peak, hbm_live_bytes=hbm_live,
+            prefetch_stall_seconds_total=(prefetcher.stall_s
+                                          if prefetcher else 0.0),
+            rollbacks_total=guard.rollbacks,
+            faults_total=fault_count)
+        try:
+            metrics.write()
+        except OSError as e:
+            import warnings
+
+            warnings.warn(f"metrics export failed: {e}", RuntimeWarning)
+            return
+        # mirror the published snapshot into the obs stream so the
+        # scrape and the JSONL never disagree (and the Perfetto counter
+        # lanes have a source)
+        olog.event("metrics", path=metrics.path,
+                   **metrics.finite_values())
+
+    def _sim_comm_s(self):
+        """The simulator's collective-seconds estimate for the loaded
+        strategy (per-op collective + dispatch overhead,
+        StrategySearch.cost_breakdown) — the preferred source of the
+        step_budget ``comm`` bucket.  None when no strategy is loaded or
+        the simulation fails."""
+        if not self.config.strategies:
+            return None
+        try:
+            from flexflow_tpu.sim.search import StrategySearch
+
+            ss = StrategySearch(self, machine=self.machine)
+            rows = ss.cost_breakdown(
+                ss.assignment_for(self.config.strategies))
+            return sum(r["collective_s"] for r in rows)
+        except Exception:
+            return None
+
+    def _emit_step_budget(self, olog, totals, op_samples, op_rows,
+                          elapsed, n_timed):
+        """The run's ``step_budget`` record (obs/budget.py): one sampled
+        (or loop-mean) step's wall time decomposed into compute / comm /
+        input_stall / host_sync / checkpoint / residual buckets, every
+        input an existing measurement or an amortized total — zero new
+        syncs.  Skipped only when the run produced no timed steps."""
+        from flexflow_tpu.obs.budget import build_step_budget
+
+        sources = {}
+        walls = sorted(s["step_s"] for s in op_samples
+                       if s.get("step_s"))
+        if walls:
+            wall = walls[len(walls) // 2]
+            sources["wall"] = "sampled_step"
+        elif n_timed > 0 and elapsed > 0:
+            wall = elapsed / n_timed
+            sources["wall"] = "loop_mean"
+        else:
+            return
+        compute = None
+        if op_rows:
+            # isolated per-op shard timings estimate fwd+bwd compute
+            # without collectives; the optimizer section (real step minus
+            # fwd+bwd section) adds the update's compute + its comm
+            iso = sum(r["seconds"] for r in op_rows)
+            opts = sorted(max(s["step_s"] - s["forward_backward"], 0.0)
+                          for s in op_samples
+                          if s.get("step_s") is not None
+                          and s.get("forward_backward") is not None)
+            opt = opts[len(opts) // 2] if opts else 0.0
+            compute = iso + opt
+            sources["compute"] = (
+                "isolated_ops+optimizer_section"
+                if all(r["measured"] for r in op_rows)
+                else "isolated_ops(analytic_standins)+optimizer_section")
+        comm = self._sim_comm_s()
+        if comm is not None:
+            sources["comm"] = "sim"
+        elif op_rows:
+            # measured residual: the fused fwd+bwd section minus the
+            # isolated compute sum is the in-step communication the
+            # isolated harness cannot see (clamped — isolation overhead
+            # can exceed fusion wins)
+            fbs = sorted(s["forward_backward"] for s in op_samples
+                         if s.get("forward_backward") is not None)
+            if fbs:
+                comm = max(fbs[len(fbs) // 2]
+                           - sum(r["seconds"] for r in op_rows), 0.0)
+                sources["comm"] = "section_residual"
+        steps = max(int(totals.get("steps", 0)), 1)
+        batches = int(totals.get("input_batches", 0)) or steps
+        bud = build_step_budget(
+            wall,
+            compute_s=compute,
+            comm_s=comm,
+            input_stall_s=totals.get("input_stall_s", 0.0) / batches,
+            host_sync_s=totals.get("host_sync_s", 0.0) / steps,
+            checkpoint_s=totals.get("checkpoint_s", 0.0) / steps,
+            sources=sources, n_samples=len(op_samples))
+        olog.event("step_budget", **bud)
 
     def _emit_fit_records(self, olog, clock, losses, start_iter, warmup,
                           num_iterations, elapsed, throughput,
                           step, params, state, opt_state, batch,
-                          op_samples=(), sample_every=0):
+                          op_samples=(), sample_every=0,
+                          budget_totals=None):
         """Write the fit surface's obs records (compile, per-step, summary,
-        op_time, sim_drift).  Runs strictly AFTER the timed loop — the
-        only in-loop obs costs are StepClock.tick() and, when the
-        op-timing mode is on, the sampled steps' explicit syncs."""
+        op_time, sim_drift, step_budget).  Runs strictly AFTER the timed
+        loop — the only in-loop obs costs are StepClock.tick() and, when
+        the op-timing mode is on, the sampled steps' explicit syncs."""
         bsz = self.config.batch_size
         # one-time compile record: the first call's wall time is the
         # host-observable compile cost (trace + partition + XLA compile +
@@ -1679,8 +1911,13 @@ class FFModel:
                    warmup=warmup - start_iter, elapsed_s=elapsed,
                    images_per_sec=throughput,
                    final_loss=losses[-1] if losses else None)
+        op_rows = []
         if sample_every and op_samples:
-            self._emit_op_times(olog, op_samples)
+            op_rows = self._emit_op_times(olog, op_samples)
+        if budget_totals is not None:
+            self._emit_step_budget(olog, budget_totals, op_samples,
+                                   op_rows, elapsed,
+                                   num_iterations - warmup)
         # sim_drift, or an explicit record of WHY it is missing — a
         # silently absent gauge reads as "no drift" (round-1 satellite)
         n_timed = num_iterations - warmup

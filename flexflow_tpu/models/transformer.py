@@ -48,6 +48,9 @@ class TransformerConfig:
     # run telemetry (forwarded to FFConfig; obs subsystem)
     obs_dir: str = ""
     run_id: str = ""
+    # sampled per-op timing + live metrics export (MFU-waterfall round)
+    op_time_every: int = 0
+    metrics_path: str = ""
     # execution performance (forwarded to FFConfig; round 6)
     regrid_planner: str = "on"
     prefetch_depth: int = 2
@@ -80,6 +83,8 @@ class TransformerLM(FFModel):
             dry_compile=self.t.dry_compile,
             obs_dir=self.t.obs_dir,
             run_id=self.t.run_id,
+            op_time_every=self.t.op_time_every,
+            metrics_path=self.t.metrics_path,
             regrid_planner=self.t.regrid_planner,
             prefetch_depth=self.t.prefetch_depth,
             ckpt_dir=self.t.ckpt_dir,

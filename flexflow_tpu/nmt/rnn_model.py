@@ -59,6 +59,9 @@ class RnnConfig:
     # run telemetry (forwarded to FFConfig; obs subsystem)
     obs_dir: str = ""
     run_id: str = ""
+    # sampled per-op timing + live metrics export (MFU-waterfall round)
+    op_time_every: int = 0
+    metrics_path: str = ""
     # execution performance (forwarded to FFConfig; round 6)
     regrid_planner: str = "on"
     prefetch_depth: int = 2
@@ -151,6 +154,8 @@ class RnnModel(FFModel):
             dry_compile=self.rnn.dry_compile,
             obs_dir=self.rnn.obs_dir,
             run_id=self.rnn.run_id,
+            op_time_every=self.rnn.op_time_every,
+            metrics_path=self.rnn.metrics_path,
             regrid_planner=self.rnn.regrid_planner,
             prefetch_depth=self.rnn.prefetch_depth,
             ckpt_dir=self.rnn.ckpt_dir,

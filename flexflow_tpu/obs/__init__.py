@@ -21,6 +21,11 @@ fished out of mixed stdout.  This package gives them ONE record schema:
     execution-performance pair (round 6) — ``regrid_plan`` (the regrid
     planner's coalescing/hop accounting, parallel/regrid.py) and
     ``prefetch`` (device-prefetch stall residual, data/prefetch.py) —
+    the MFU-waterfall pair (observability round 3): ``step_budget``
+    (one step's wall time decomposed into compute / comm / input_stall /
+    host_sync / checkpoint / residual buckets summing to the wall,
+    obs/budget.py) and ``metrics`` (a mirror of each live-gauge snapshot
+    the Prometheus exporter published, obs/metrics.py) —
     and the fault-tolerance family (robustness round): ``fault`` (an
     injected fault firing, a health-guard divergence detection, or a
     refused non-finite checkpoint), ``rollback`` (guard-driven restore

@@ -1,0 +1,185 @@
+"""Always-on live metrics export: a Prometheus textfile plus a JSON
+snapshot, atomically rewritten at the training loop's existing host-sync
+boundaries.
+
+The obs JSONL is an append-only event stream — perfect for post-hoc
+analysis, wrong for "is the 30-hour run still healthy?": answering that
+from JSONL means tailing and parsing an unbounded file.  This module
+publishes the handful of gauges an operator actually watches —
+throughput, MFU, HBM peak/live bytes, rollback/fault counters, prefetch
+stall — as two small files any scraper understands:
+
+  * ``<metrics_path>`` — Prometheus *textfile collector* format
+    (``# HELP`` / ``# TYPE`` / ``name value`` lines; point a node
+    exporter's ``--collector.textfile.directory`` at the parent dir, or
+    read it with :func:`read_textfile`);
+  * ``<metrics_path>.json`` — the same gauges as one JSON object, for
+    tooling that wants types without a Prometheus parser.
+
+Contracts:
+
+  * **atomic rewrite** — each write goes to a tempfile in the target
+    directory and ``os.replace``s into place, so a scraper never reads a
+    torn file;
+  * **finite values only** — a gauge whose value is None/NaN/inf is
+    dropped from the files (a poisoned loss must not corrupt the
+    scrape); counters are monotone within one exporter's lifetime;
+  * **host-boundary cadence** — ``fit()`` updates at print/checkpoint
+    boundaries and once post-loop, never from the device hot path
+    (``FFConfig.metrics_path`` enables it, independent of ``obs_dir``).
+
+Every written snapshot is also mirrored as a ``metrics`` obs record when
+the run has a live obs stream, so the JSONL and the scrape never
+disagree.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import tempfile
+import time
+from typing import Dict, Optional
+
+PREFIX = "ff_"
+
+# gauge name -> HELP text; written in this order.  Anything update()d
+# outside this table is still exported (HELP omitted).
+_HELP = {
+    "throughput_items_per_sec": "training throughput (items/s, machine)",
+    "images_per_sec": "training throughput alias (images/s, machine)",
+    "mfu": "achieved model FLOPs utilization (0..1)",
+    "mfu_ceiling": "roofline MFU ceiling of the compiled step (0..1)",
+    "step_wall_seconds": "recent mean wall seconds per step",
+    "loss": "most recent training loss",
+    "steps_total": "training steps completed this run",
+    "hbm_peak_bytes": "peak device memory (runtime stats, else compiled "
+                      "memory analysis estimate)",
+    "hbm_live_bytes": "device bytes currently in use (runtime stats)",
+    "prefetch_stall_seconds_total": "input stall the prefetch overlap "
+                                    "could not hide",
+    "rollbacks_total": "health-guard rollbacks this run",
+    "faults_total": "fault records this run (injected, detected, or "
+                    "refused-checkpoint)",
+}
+_COUNTERS = {"steps_total", "rollbacks_total", "faults_total",
+             "prefetch_stall_seconds_total"}
+
+
+def _finite(v) -> Optional[float]:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+class MetricsExporter:
+    """Holds the current gauge values and rewrites the export files.
+
+    ``update(**gauges)`` merges new values (None/non-finite dropped at
+    write time), ``write()`` publishes both files atomically.  The
+    exporter also carries a small ``meta`` dict (model/run id) rendered
+    as an ``ff_run_info`` label line, and a scratch ``cache`` dict fit()
+    uses to memoize compiled-cost lookups across boundaries."""
+
+    def __init__(self, path: str, meta: Optional[Dict] = None):
+        self.path = path
+        self.json_path = path + ".json"
+        self.meta = dict(meta or {})
+        self.cache: Dict = {}
+        self.values: Dict[str, float] = {}
+        self._writes = 0
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+
+    def update(self, **gauges) -> None:
+        for k, v in gauges.items():
+            self.values[k] = v
+
+    def finite_values(self) -> Dict[str, float]:
+        out = {}
+        for k, v in self.values.items():
+            f = _finite(v)
+            if f is not None:
+                out[k] = f
+        return out
+
+    def render(self) -> str:
+        vals = self.finite_values()
+        lines = []
+        if self.meta:
+            labels = ",".join(
+                f'{k}="{v}"' for k, v in sorted(self.meta.items()))
+            lines.append(f"# HELP {PREFIX}run_info run identity labels")
+            lines.append(f"# TYPE {PREFIX}run_info gauge")
+            lines.append(f"{PREFIX}run_info{{{labels}}} 1")
+        ordered = [k for k in _HELP if k in vals] \
+            + sorted(k for k in vals if k not in _HELP)
+        for k in ordered:
+            name = PREFIX + k
+            if k in _HELP:
+                lines.append(f"# HELP {name} {_HELP[k]}")
+            lines.append(f"# TYPE {name} "
+                         f"{'counter' if k in _COUNTERS else 'gauge'}")
+            lines.append(f"{name} {vals[k]:.10g}")
+        return "\n".join(lines) + "\n"
+
+    def write(self) -> None:
+        """Atomic rewrite of the textfile and the JSON snapshot (a
+        failed write never tears the published files)."""
+        self._writes += 1
+        _replace(self.path, self.render())
+        snap = {"ts": time.time(), "writes": self._writes,
+                "meta": self.meta, "gauges": self.finite_values()}
+        _replace(self.json_path, json.dumps(snap, indent=1) + "\n")
+
+
+def _replace(path: str, content: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".metrics-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(content)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def from_config(config, meta: Optional[Dict] = None) \
+        -> Optional[MetricsExporter]:
+    """A live exporter when ``config.metrics_path`` is set, else None.
+    Independent of ``obs_dir`` — a run may scrape without JSONL."""
+    path = getattr(config, "metrics_path", "") or ""
+    if not path:
+        return None
+    return MetricsExporter(path, meta=meta)
+
+
+def read_textfile(path: str) -> Dict[str, float]:
+    """Parse a Prometheus textfile back into ``{bare_name: value}`` (the
+    ``ff_`` prefix stripped, label lines like ``run_info`` skipped) —
+    the verification half of the export used by tests and
+    ``make budget-smoke``.  Raises ValueError on a malformed sample
+    line, which is exactly what "the textfile parses" means."""
+    out: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"malformed metrics line: {line!r}")
+            name, value = parts
+            if "{" in name:
+                continue  # labeled info series
+            if not name.startswith(PREFIX):
+                raise ValueError(f"unexpected metric name: {name!r}")
+            out[name[len(PREFIX):]] = float(value)
+    return out

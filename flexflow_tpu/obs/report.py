@@ -148,6 +148,32 @@ def _fit_section(events: List[Dict]) -> List[str]:
             f"  prefetch: depth {p.get('depth', '?')}, "
             f"{p.get('batches', 0)} batches, input stall "
             f"{_fmt_s(p.get('input_stall_s', 0.0))}")
+    # step-budget + live-metrics records (MFU waterfall round): one
+    # summary line each; the full waterfall is `report budget`
+    for b in (e for e in events if e.get("kind") == "step_budget"):
+        bk = b.get("buckets") or {}
+        wall = b.get("step_wall_s", 0.0) or 0.0
+        parts = [f"{k} {_fmt_s(v)}"
+                 for k, v in sorted(bk.items(), key=lambda kv: -kv[1])
+                 if v > 0]
+        lines.append(
+            f"  step budget ({_fmt_s(wall)} wall, "
+            f"{b.get('n_samples', 0)} samples): "
+            + (", ".join(parts) if parts else "(all zero)")
+            + "  [render: report budget]")
+    mets = [e for e in events if e.get("kind") == "metrics"]
+    if mets:
+        m = mets[-1]
+        parts = []
+        if m.get("images_per_sec") is not None:
+            parts.append(f"{m['images_per_sec']:.1f} items/s")
+        if m.get("mfu") is not None:
+            parts.append(f"mfu {m['mfu']:.4f}")
+        if m.get("hbm_peak_bytes"):
+            parts.append(f"hbm peak {m['hbm_peak_bytes'] / 1e9:.3f} GB")
+        lines.append(f"  metrics export ({len(mets)} writes"
+                     + (f", {m['path']}" if m.get("path") else "")
+                     + "): " + (", ".join(parts) or "(no finite gauges)"))
     return lines
 
 
@@ -293,11 +319,22 @@ def _audit_bench_section(events: List[Dict]) -> List[str]:
             f"vs DP {a.get('dp_cross_mb', '?')} MB -> "
             f"{'CONSISTENT' if a.get('consistent') else 'CONTRADICTED'}")
     for b in benches:
+        extras = ""
+        if b.get("mfu") is not None:
+            extras += f", mfu {b['mfu']}"
+        if b.get("mfu_ceiling") is not None:
+            extras += f" (ceiling {b['mfu_ceiling']})"
+        if b.get("hbm_peak_gb") is not None:
+            extras += f", hbm {b['hbm_peak_gb']} GB"
+        shares = ", ".join(f"{k[:-5]} {100.0 * b[k]:.1f}%"
+                           for k in ("comm_frac", "stall_frac")
+                           if isinstance(b.get(k), (int, float)))
+        if shares:
+            extras += f", shares: {shares}"
         lines.append(
             f"  bench: {b.get('metric', '?')} = {b.get('value', '?')} "
             f"{b.get('unit', '')} (vs_baseline {b.get('vs_baseline', '?')}"
-            + (f", mfu {b['mfu']}" if b.get("mfu") is not None else "")
-            + ")")
+            + extras + ")")
     return lines
 
 
@@ -322,6 +359,7 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "search_chunk", "search_result", "search_breakdown",
              "pipeline_candidate", "pipeline_decision", "hlo_audit",
              "bench", "regrid_plan", "prefetch",
+             "step_budget", "metrics",
              "fault", "rollback", "recovery", "data_fault",
              "ckpt_fallback", "thread_leak"}
     lines = []
@@ -486,6 +524,27 @@ def summarize(events: Iterable[Dict]) -> Dict:
                              "total_s": t.get("total_s"),
                              "dp_total_s": t.get("dp_total_s")}
                             for t in traces]
+    budgets = [e for e in events if e.get("kind") == "step_budget"]
+    if budgets:
+        b = budgets[-1]
+        out["step_budget"] = {
+            "step_wall_s": b.get("step_wall_s"),
+            "buckets": b.get("buckets"),
+            "sources": b.get("sources"),
+            "clamped": b.get("clamped"),
+            "n_samples": b.get("n_samples"),
+        }
+    mets = [e for e in events if e.get("kind") == "metrics"]
+    if mets:
+        m = mets[-1]
+        out["metrics"] = {
+            "writes": len(mets),
+            "path": m.get("path"),
+            "gauges": {k: v for k, v in m.items()
+                       if k not in ("run", "ts", "kind", "surface",
+                                    "path")
+                       and isinstance(v, (int, float))},
+        }
     fault_kinds = ("fault", "rollback", "recovery", "data_fault",
                    "ckpt_fallback", "thread_leak")
     if any(kinds.get(k) for k in fault_kinds):

@@ -101,7 +101,11 @@ def fit_trace_events(records: Iterable[Dict], pid: int = PID_REAL,
     ``sections`` thread in record order; isolated per-op shard timings on
     an ``ops (isolated shard)`` thread.  Timestamps are synthetic
     cursors — the lanes show relative durations side by side with the
-    simulated schedule, not wall-clock alignment."""
+    simulated schedule, not wall-clock alignment.  Counter lanes
+    (:func:`fit_counter_events`) ride along: per-step throughput from
+    ``step`` records plus MFU / HBM bytes from ``metrics`` records,
+    rendered by Perfetto as value-over-time tracks under the same
+    process."""
     records = list(records)
     sections = [r for r in records if r.get("kind") == "op_time"
                 and r.get("scope") == "section"]
@@ -131,6 +135,64 @@ def fit_trace_events(records: Iterable[Dict], pid: int = PID_REAL,
                 "args": {"op_kind": r.get("op_kind"), "seconds": dur,
                          "measured": r.get("measured")}})
             t += dur
+    events.extend(fit_counter_events(records, pid=pid))
+    return events
+
+
+def fit_counter_events(records: Iterable[Dict],
+                       pid: int = PID_REAL) -> List[Dict]:
+    """Perfetto **counter** lanes (``ph: "C"``) of a fit run's gauges on
+    the run's own step-time axis:
+
+      * ``imgs/s`` — per-step throughput from the ``step`` records,
+        sampled at each step's cumulative wall time;
+      * ``MFU`` and ``HBM bytes`` (live/peak) — from the ``metrics``
+        records the exporter mirrors into the obs stream, positioned at
+        the cumulative wall time of the step count each snapshot
+        reports.
+
+    Counter events carry their series values in ``args`` (Perfetto
+    renders one track per arg key).  Empty when the stream has neither
+    record kind."""
+    records = list(records)
+    steps = [r for r in records if r.get("kind") == "step"
+             and isinstance(r.get("wall_ms"), (int, float))]
+    metrics = [r for r in records if r.get("kind") == "metrics"]
+    events: List[Dict] = []
+    # cumulative wall-clock cursor per step (seconds), indexed by step
+    # ordinal — the shared time axis of every counter lane
+    cum: List[float] = [0.0]
+    t = 0.0
+    for r in steps:
+        t += float(r["wall_ms"]) / 1e3
+        cum.append(t)
+
+    def at_step(n) -> float:
+        try:
+            n = int(n)
+        except (TypeError, ValueError):
+            return cum[-1]
+        return cum[min(max(n, 0), len(cum) - 1)]
+
+    for i, r in enumerate(steps):
+        v = r.get("images_per_sec")
+        if isinstance(v, (int, float)):
+            events.append({"name": "imgs/s", "ph": "C", "pid": pid,
+                           "tid": 0, "ts": cum[i + 1] * _US,
+                           "args": {"imgs/s": float(v)}})
+    for r in metrics:
+        ts = at_step(r.get("steps_total", None)) * _US
+        mfu = r.get("mfu")
+        if isinstance(mfu, (int, float)):
+            events.append({"name": "MFU", "ph": "C", "pid": pid,
+                           "tid": 0, "ts": ts,
+                           "args": {"mfu": float(mfu)}})
+        hbm = {k: float(r[k]) for k in ("hbm_live_bytes",
+                                        "hbm_peak_bytes")
+               if isinstance(r.get(k), (int, float))}
+        if hbm:
+            events.append({"name": "HBM bytes", "ph": "C", "pid": pid,
+                           "tid": 0, "ts": ts, "args": hbm})
     return events
 
 
@@ -154,11 +216,15 @@ def write_trace(path: str, trace: Dict) -> str:
 
 def validate_trace(trace: Any) -> List[str]:
     """Schema check for a ``trace_event`` object: required keys per
-    event, non-negative timestamps/durations, and non-overlapping
-    (monotone) compute intervals per (pid, tid) lane.  Returns the list
-    of violations — empty means the trace is loadable and internally
-    consistent.  Transfer lanes are exempt from the overlap check:
-    concurrent flows into one device legitimately overlap."""
+    event, non-negative timestamps/durations, non-overlapping (monotone)
+    compute intervals per (pid, tid) lane, and — for counter events
+    (``ph: "C"``) — an ``args`` dict of finite numeric series values.
+    Returns the list of violations — empty means the trace is loadable
+    and internally consistent.  Transfer lanes are exempt from the
+    overlap check: concurrent flows into one device legitimately
+    overlap."""
+    import math
+
     errors: List[str] = []
     if not isinstance(trace, dict) or not isinstance(
             trace.get("traceEvents"), list):
@@ -174,11 +240,25 @@ def validate_trace(trace: Any) -> List[str]:
         ph = ev.get("ph")
         if ph == "M":
             continue
-        if "tid" not in ev:
+        if ph != "C" and "tid" not in ev:
             errors.append(f"event {i}: missing required key 'tid'")
         ts = ev.get("ts")
         if not isinstance(ts, (int, float)) or ts < 0:
             errors.append(f"event {i}: ts must be a non-negative number")
+            continue
+        if ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args:
+                errors.append(
+                    f"event {i}: counter event needs a non-empty args "
+                    f"dict of series values")
+                continue
+            for k, v in args.items():
+                if not isinstance(v, (int, float)) \
+                        or not math.isfinite(v):
+                    errors.append(
+                        f"event {i}: counter series {k!r} must be a "
+                        f"finite number, got {v!r}")
             continue
         if ph == "X":
             dur = ev.get("dur")

@@ -30,6 +30,19 @@ def flash_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def tpu_compiler_params():
+    """The pallas-TPU compiler-params class under whichever name this
+    jax release exports it (``TPUCompilerParams`` was renamed
+    ``CompilerParams``); None when neither exists.  The capability gate
+    for kernels that must raise the scoped-VMEM cap (maxpool) and for
+    the tests that exercise them — a None here means "skip with a
+    reason", not an AttributeError mid-kernel."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+
+
 def maxpool_enabled() -> bool:
     """Policy gate for the Pallas max-pool backward: OFF by default.
     Per-op it beats XLA's select_and_scatter ~2x (2.9 vs 5.0 ms on
@@ -45,4 +58,5 @@ def maxpool_enabled() -> bool:
         in ("1", "true")
 
 
-__all__ = ["flash_attention", "flash_enabled", "maxpool_enabled"]
+__all__ = ["flash_attention", "flash_enabled", "maxpool_enabled",
+           "tpu_compiler_params"]

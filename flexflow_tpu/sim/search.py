@@ -1080,10 +1080,13 @@ class StrategySearch:
             self._emit_breakdown(best)
         return self.assignment_to_strategy(best), info
 
-    def _emit_breakdown(self, assignment: Sequence[int]) -> None:
-        """Per-op cost breakdown of an assignment (the winning strategy's
-        ``search_breakdown`` obs record).  Costs come from the already-
-        warmed cost model (a measured model serves its cache)."""
+    def cost_breakdown(self, assignment: Sequence[int]) -> list:
+        """Per-op cost rows of an assignment: ``{op, kind, dims, devices,
+        compute_s, collective_s}`` per graph op (input sources excluded).
+        Costs come from the already-warmed cost model (a measured model
+        serves its cache).  Shared by the winning strategy's
+        ``search_breakdown`` obs record, fit()'s ``step_budget`` comm
+        bucket, and bench.py's ``comm_frac`` gauge."""
         topo = self.machine.topology
         n_dev = self.machine.num_devices
         rows = []
@@ -1099,5 +1102,10 @@ class StrategySearch:
                 "collective_s": float(
                     collective_cost(op, pc, topo)
                     + dispatch_overhead_cost(op, pc, topo, n_dev))})
-        self.obs.event("search_breakdown", ops=rows,
+        return rows
+
+    def _emit_breakdown(self, assignment: Sequence[int]) -> None:
+        """The winning strategy's ``search_breakdown`` obs record."""
+        self.obs.event("search_breakdown",
+                       ops=self.cost_breakdown(assignment),
                        opt_stream_s=self._opt_stream_s)

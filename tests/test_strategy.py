@@ -80,11 +80,20 @@ message Strategy {
 
 
 def test_proto_cross_validation(tmp_path):
-    """Serialize with protoc-generated code, parse with ours, and back."""
+    """Serialize with protoc-generated code, parse with ours, and back.
+
+    Capability-gated: needs BOTH the protobuf python runtime and the
+    ``protoc`` binary on PATH — environments without the compiler skip
+    with the explicit reason instead of erroring on FileNotFoundError,
+    so a tier-1 failure here always means a real wire-format break."""
     try:
         from google.protobuf import descriptor_pb2  # noqa: F401
     except ImportError:
         pytest.skip("protobuf python runtime unavailable")
+    import shutil
+
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc binary not on PATH")
     proto = tmp_path / "strat.proto"
     proto.write_text(PROTO_SRC)
     r = subprocess.run(
