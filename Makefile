@@ -1,7 +1,8 @@
 # Repo-level entry points; the native build lives in flexflow_tpu/native.
 PYTHON ?= python
 
-.PHONY: native check trace-smoke test bench-smoke fault-smoke budget-smoke
+.PHONY: native check trace-smoke test bench-smoke fault-smoke budget-smoke \
+	elastic-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -39,6 +40,17 @@ bench-smoke:
 # on a healthy run
 fault-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m flexflow_tpu.apps.fault_smoke
+
+# elastic-runtime smoke (elastic round): equivalence phase (elastic-
+# enabled no-fault run bit-identical to baseline) + recovery phase (an
+# injected permanent device loss shrinks the 8-device simulated mesh to
+# 6 mid-run: surviving-mesh re-search + live-state regrid, exactly one
+# elastic_resize record, finite losses to completion, and a verified
+# async-committed final checkpoint)
+elastic-smoke:
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.elastic_smoke
 
 # MFU-waterfall smoke (observability): tiny CNN with sampled op timing +
 # live metrics export; asserts the step_budget bucket invariant, a

@@ -106,7 +106,10 @@ def main(argv=None, log=print) -> dict:
     data_olog = obs.from_config(cfg, surface="data")
     try:
         data = make_data(cfg, machine, dataset, olog=data_olog)
-        out = ff.fit(data, log=log)
+        # the builder doubles as the elastic rebuild factory: on
+        # permanent device loss (--elastic) fit() reconstructs the graph
+        # on the surviving mesh through it (utils/elastic.py)
+        out = ff.fit(data, log=log, rebuild=builders[model_name])
     finally:
         data_olog.close()
     out.pop("params", None)

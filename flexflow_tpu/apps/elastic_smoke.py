@@ -1,0 +1,174 @@
+"""Elastic-runtime smoke — the ``make elastic-smoke`` entry point
+(elastic round).
+
+Two phases, mirroring ``fault_smoke``'s assertion style:
+
+  1. **equivalence** — with ``--elastic`` ENABLED but no faults injected,
+     the run must produce BIT-EQUAL losses to a baseline (elastic off)
+     run: the elastic machinery adds no per-step host syncs and never
+     perturbs a healthy run;
+  2. **recovery** — a tiny CNN trains on an 8-device simulated CPU mesh
+     with ``device_loss@3x2`` injected (ordinals 7 then 6 die at steps 3
+     and 4), under ``--elastic --ckpt-async``.  The run must COMPLETE
+     all iterations with finite losses after shrinking onto the
+     6-device surviving mesh, the obs stream must carry exactly ONE
+     ``elastic_resize`` record (re-search + live regrid, zero steps
+     lost), and the final checkpoint — committed by the async writer —
+     must verify clean.
+
+Everything runs on CPU in seconds; assertion failures exit non-zero.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python -m flexflow_tpu.apps.elastic_smoke
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+
+FAULT_SPEC = "device_loss@3x2"
+ITERS = 12
+BATCH = 24  # divisible by both the 8-device and the 6-device mesh
+
+
+def _build(cfg, machine):
+    from flexflow_tpu.model import FFModel
+
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((cfg.batch_size, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def _host_batches(seed: int = 3, n: int = 4):
+    """HOST numpy batches (the prefetcher places them with the CURRENT
+    machine's sharding) — after a resize the continuation re-places onto
+    the surviving mesh instead of feeding stale 8-device arrays."""
+    rng = np.random.RandomState(seed)
+    ring = [(rng.randn(BATCH, 16, 16, 3).astype("float32"),
+             rng.randint(0, 8, (BATCH,)).astype("int32"))
+            for _ in range(n)]
+    i = 0
+    while True:
+        yield ring[i % n]
+        i += 1
+
+
+def _cfg(**kw):
+    from flexflow_tpu.config import FFConfig
+
+    base = dict(batch_size=BATCH, input_height=16, input_width=16,
+                num_iterations=ITERS, print_freq=2, num_classes=8,
+                seed=3)
+    base.update(kw)
+    return FFConfig(**base)
+
+
+def _check_equivalence(machine, log) -> None:
+    """Elastic-enabled-but-healthy == baseline: losses bit-equal, zero
+    behavior drift from the elastic machinery itself."""
+    def run(**kw):
+        ff = _build(_cfg(num_iterations=4, print_freq=0, **kw), machine)
+        return ff.fit(_host_batches(), log=lambda *a: None,
+                      rebuild=_build)["loss"]
+
+    a = run()                                    # baseline (elastic off)
+    b = run(elastic=True, min_devices=2)         # elastic, no faults
+    assert a == b, \
+        f"elastic must be byte-inert on healthy runs: {a} vs {b}"
+    log(f"equivalence ok: {len(a)} losses bit-equal with and without "
+        f"--elastic")
+
+
+def main(argv=None, log=print) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu import obs
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.obs.report import summarize
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    if jax.device_count() != 8:
+        log(f"elastic-smoke needs the 8-device simulated mesh "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=8), "
+            f"got {jax.device_count()} devices")
+        return 2
+    machine = MachineModel()
+    _check_equivalence(machine, log)
+
+    with tempfile.TemporaryDirectory(prefix="ff-elastic-smoke-") as td:
+        cfg = _cfg(ckpt_dir=os.path.join(td, "ckpt"), ckpt_freq=2,
+                   obs_dir=os.path.join(td, "obs"),
+                   run_id="elastic-smoke", elastic=True, min_devices=2,
+                   ckpt_async=True, research_budget_s=10.0,
+                   fault_spec=FAULT_SPEC)
+        ff = _build(cfg, machine)
+        out = ff.fit(_host_batches(), log=log, rebuild=_build)
+
+        assert len(out["loss"]) == ITERS, \
+            f"run must complete all {ITERS} iterations, got " \
+            f"{len(out['loss'])}"
+        assert all(math.isfinite(l) for l in out["loss"]), \
+            f"post-resize loss history must be finite: {out['loss']}"
+        assert out["elastic_resizes"] == 1, \
+            f"expected exactly one resize, got {out['elastic_resizes']}"
+        assert out["devices"] == 6, \
+            f"run must end on the 6-device surviving mesh, got " \
+            f"{out['devices']}"
+        last = ckpt.latest_step(cfg.ckpt_dir)
+        ok, why = ckpt.verify_checkpoint(cfg.ckpt_dir, last)
+        assert last == ITERS and ok, \
+            f"final (async-committed) checkpoint must verify clean: " \
+            f"step {last}, {why}"
+
+        events = list(obs.read_run(out["obs_path"]))
+        kinds = [e["kind"] for e in events]
+        resizes = [e for e in events if e["kind"] == "elastic_resize"]
+        assert len(resizes) == 1, \
+            f"expected exactly one elastic_resize record, got " \
+            f"{len(resizes)} in {sorted(set(kinds))}"
+        rz = resizes[0]
+        assert rz["from_devices"] == 8 and rz["to_devices"] == 6, rz
+        assert rz["migration"] in ("in_memory", "checkpoint"), rz
+        i_inj = next(i for i, e in enumerate(events)
+                     if e["kind"] == "fault"
+                     and e.get("fault") == "device_loss")
+        i_det = next(i for i, e in enumerate(events)
+                     if e["kind"] == "device_loss")
+        i_rz = events.index(rz)
+        assert i_inj < i_det < i_rz, \
+            "records must read injected fault -> device_loss -> " \
+            "elastic_resize in order"
+        assert "ckpt_async" in kinds, \
+            f"async writer must emit ckpt_async records: " \
+            f"{sorted(set(kinds))}"
+
+        summary = summarize(events)
+        assert "elastic" in summary \
+            and summary["elastic"]["counts"].get("elastic_resize") == 1, \
+            summary.get("elastic")
+
+        log(f"elastic-smoke ok: {ITERS} iters survived {FAULT_SPEC!r} "
+            f"with one 8->6 resize (re-search "
+            f"{rz['research_s'] * 1e3:.0f} ms "
+            f"[{(rz.get('research') or {}).get('mode')}], migration "
+            f"{rz['migration']}, {rz['steps_lost']} steps lost), final "
+            f"loss {out['loss'][-1]:.4f}, verified async checkpoint at "
+            f"step {last}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

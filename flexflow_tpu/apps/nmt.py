@@ -90,6 +90,14 @@ def parse_args(argv) -> RnnConfig:
             from flexflow_tpu.config import _checked_fault_spec
 
             cfg.fault_spec = _checked_fault_spec(val())
+        elif a == "--elastic":
+            cfg.elastic = True
+        elif a == "--min-devices":
+            cfg.min_devices = int(val())
+        elif a == "--research-budget-s":
+            cfg.research_budget_s = float(val())
+        elif a == "--ckpt-async":
+            cfg.ckpt_async = True
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg

@@ -119,6 +119,21 @@ class FFConfig:
     # giving up (data/hdf5.py, data/imagenet.py)
     data_retry_attempts: int = 4
     data_skip_budget: int = 16
+    # elastic training (utils/elastic.py): --elastic turns permanent
+    # device loss into recovery on the surviving mesh (re-search + live
+    # regrid, checkpoint fallback) instead of a fatal error; a shrink
+    # below --min-devices raises ElasticShrinkRefused instead of limping.
+    # --research-budget-s caps the surviving-mesh re-search wall clock;
+    # elastic_search_iters its proposal count.
+    elastic: bool = False
+    min_devices: int = 1
+    research_budget_s: float = 30.0
+    elastic_search_iters: int = 2000
+    # async checkpointing (utils/checkpoint.AsyncCheckpointWriter):
+    # serialization/digest/commit on a background writer, at most one
+    # save in flight; fit blocks only on the final save and before a
+    # rollback restore.  Off by default — the sync path is unchanged.
+    ckpt_async: bool = False
 
     strategies: Strategy = dataclasses.field(default_factory=Strategy)
 
@@ -203,6 +218,16 @@ class FFConfig:
                 cfg.data_retry_attempts = int(val())
             elif a == "--data-skip-budget":
                 cfg.data_skip_budget = int(val())
+            elif a == "--elastic":
+                cfg.elastic = True
+            elif a == "--min-devices":
+                cfg.min_devices = int(val())
+            elif a == "--research-budget-s":
+                cfg.research_budget_s = float(val())
+            elif a == "--elastic-search-iters":
+                cfg.elastic_search_iters = int(val())
+            elif a == "--ckpt-async":
+                cfg.ckpt_async = True
             elif a == "--ckpt-dir":
                 cfg.ckpt_dir = val()
             elif a == "--ckpt-freq":

@@ -21,16 +21,28 @@ communication is derived, not written.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 from flexflow_tpu.machine import MachineModel, Topology
+
+# did THIS process bring up a jax.distributed client?  release()/rejoin
+# consult it so single-process runs never touch the coordinator.
+_STATE = {"initialized": False}
+
+
+def is_initialized() -> bool:
+    """True when this process initialized (and still holds) the
+    jax.distributed client."""
+    return _STATE["initialized"]
 
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
                local_device_ids: Optional[Sequence[int]] = None,
-               topology: Optional[Topology] = None) -> MachineModel:
+               topology: Optional[Topology] = None,
+               coordinator_timeout_s: Optional[float] = None,
+               connect_attempts: int = 1) -> MachineModel:
     """Connect this process to the cluster and return the global machine.
 
     On Cloud TPU all arguments are auto-detected from the metadata server;
@@ -42,7 +54,15 @@ def initialize(coordinator_address: Optional[str] = None,
     The returned MachineModel spans every device of every process, with a
     two-tier Topology (ICI inside a slice = this host's local device
     count per group by default; DCN across) feeding the strategy-search
-    cost model."""
+    cost model.
+
+    Coordinator-timeout handling (elastic round): the explicit path
+    passes ``coordinator_timeout_s`` through to jax.distributed's
+    ``initialization_timeout`` (where the installed jax supports it) and
+    retries a timed-out connection up to ``connect_attempts`` times with
+    bounded deterministic backoff (utils/retry.py) — a respawned host
+    arriving before its coordinator is a normal event under ``--elastic``
+    restarts, not an error."""
     import os
 
     import jax
@@ -56,23 +76,56 @@ def initialize(coordinator_address: Optional[str] = None,
         "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
         "MEGASCALE_COORDINATOR_ADDRESS", "TPU_PROCESS_ADDRESSES"))
     if explicit:
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-                local_device_ids=local_device_ids)
-        except RuntimeError as e:
-            # second initialize() in the same process: keep the existing
-            # client (jax.distributed is one-shot; use shutdown() before
-            # reconfiguring).  Anything else (bad coordinator, mismatched
-            # process count) must surface, not silently degrade to a
-            # single-host world.
-            if "already initialized" not in str(e).lower():
+        def _connect():
+            kwargs = dict(coordinator_address=coordinator_address,
+                          num_processes=num_processes,
+                          process_id=process_id,
+                          local_device_ids=local_device_ids)
+            if coordinator_timeout_s is not None:
+                kwargs["initialization_timeout"] = \
+                    int(coordinator_timeout_s)
+            try:
+                jax.distributed.initialize(**kwargs)
+            except TypeError:
+                # older jax without initialization_timeout
+                kwargs.pop("initialization_timeout", None)
+                jax.distributed.initialize(**kwargs)
+            _STATE["initialized"] = True
+
+        def _connect_once():
+            try:
+                _connect()
+            except RuntimeError as e:
+                # second initialize() in the same process: keep the
+                # existing client (jax.distributed is one-shot; use
+                # shutdown() before reconfiguring).  A TIMEOUT is
+                # retryable; anything else (bad coordinator, mismatched
+                # process count) must surface, not silently degrade to a
+                # single-host world.
+                msg = str(e).lower()
+                if "already initialized" in msg:
+                    _STATE["initialized"] = True
+                    return
+                if "timeout" in msg or "timed out" in msg \
+                        or "deadline" in msg:
+                    raise TimeoutError(str(e)) from e
                 raise
+
+        if max(int(connect_attempts), 1) > 1:
+            from flexflow_tpu.utils.retry import (RetryPolicy,
+                                                  call_with_retry)
+
+            call_with_retry(
+                _connect_once,
+                policy=RetryPolicy(attempts=max(int(connect_attempts), 1),
+                                   base_delay=1.0, max_delay=10.0),
+                retry_on=(TimeoutError,))
+        else:
+            _connect_once()
     elif auto:
         try:
             jax.distributed.initialize()  # args metadata-auto-detected
+            _STATE["initialized"] = True
         except (RuntimeError, ValueError):
             # backend already initialized (dev sessions that imported jax
             # first) or metadata incomplete (RuntimeError / ValueError
@@ -95,7 +148,72 @@ def shutdown() -> None:
     """Tear down the jax.distributed client (idempotent)."""
     import jax
 
+    _STATE["initialized"] = False
     try:
         jax.distributed.shutdown()
     except Exception:
         pass
+
+
+def release() -> None:
+    """Error-path coordinator cleanup: tear down the client IF this
+    process brought one up, no-op otherwise.  ``fit()`` calls this on
+    every error exit so a crashed host releases the coordinator (and its
+    barrier slot) promptly instead of holding the other hosts until
+    their timeout — previously only a clean exit shut it down."""
+    if _STATE["initialized"]:
+        shutdown()
+
+
+def elastic_rejoin(ckpt_dir: str,
+                   coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   model=None,
+                   topology: Optional[Topology] = None,
+                   coordinator_timeout_s: float = 60.0,
+                   connect_attempts: int = 5,
+                   olog=None, log=print) -> Tuple[MachineModel, int,
+                                                  Optional[dict],
+                                                  Optional[dict],
+                                                  Optional[dict]]:
+    """The ``--elastic`` restart protocol for a RESPAWNED host.
+
+    A host that crashed (or was preempted) and came back cannot splice
+    into the surviving mesh mid-step — collectives are compiled against a
+    fixed device set.  Instead it: (1) tears down any stale client and
+    re-initializes against the coordinator, retrying connection timeouts
+    with bounded backoff (every surviving host must reach the SAME
+    restart barrier, which the orchestrator triggers by restarting them
+    with identical flags); (2) loads the newest VERIFIED checkpoint from
+    ``ckpt_dir`` (the async writer keeps one recent — a respawn costs at
+    most one checkpoint interval); (3) returns the fresh global machine
+    plus the restored ``(step, params, state, opt_state)`` so the driver
+    rebuilds its model on the rejoined mesh and resumes.
+
+    With ``model`` given, restored leaves land on the model's shardings
+    (same contract as ``restore_checkpoint``).  When no checkpoint
+    exists yet, returns step 0 with None trees (a restart before the
+    first save simply begins again)."""
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    shutdown()
+    machine = initialize(coordinator_address=coordinator_address,
+                         num_processes=num_processes,
+                         process_id=process_id, topology=topology,
+                         coordinator_timeout_s=coordinator_timeout_s,
+                         connect_attempts=connect_attempts)
+    step, params, state, opt_state = 0, None, None, None
+    if ckpt_dir and ckpt.latest_step(ckpt_dir) is not None:
+        step, params, state, opt_state = ckpt.restore_checkpoint(
+            ckpt_dir, model, olog=olog)
+        log(f"elastic rejoin: restored verified checkpoint step {step} "
+            f"from {ckpt_dir!r} on a "
+            f"{machine.num_devices}-device mesh")
+    else:
+        log(f"elastic rejoin: no checkpoint under {ckpt_dir!r}; "
+            f"rejoining from step 0")
+    if olog is not None and getattr(olog, "enabled", False):
+        olog.event("elastic_rejoin", step=step, dir=ckpt_dir,
+                   devices=machine.num_devices)
+    return machine, step, params, state, opt_state

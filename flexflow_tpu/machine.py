@@ -145,6 +145,26 @@ class MachineModel:
     def num_devices(self) -> int:
         return len(self.devices)
 
+    def shrink(self, live: Sequence[int]) -> "MachineModel":
+        """A fresh MachineModel over the SURVIVING device ordinals — the
+        elastic runtime's resize primitive (utils/elastic.py): on
+        permanent device loss the training run rebuilds its world view on
+        the live devices and re-searches a strategy for it.  ``live`` is
+        a list of ordinals into THIS machine's device list; the topology
+        is re-derived from the survivors (a shrink can merge or break ICI
+        groups, so carrying the old constants over would mis-price the
+        new mesh).  Returns a new model — this one is never mutated (the
+        old view stays valid for draining/migrating state off it)."""
+        idx = sorted(set(int(i) for i in live))
+        if not idx:
+            raise ValueError("cannot shrink to an empty device set")
+        bad = [i for i in idx if i < 0 or i >= self.num_devices]
+        if bad:
+            raise ValueError(
+                f"live ordinals {bad} out of range for this "
+                f"{self.num_devices}-device machine")
+        return MachineModel(devices=[self.devices[i] for i in idx])
+
     def _dev_array(self, shape: Tuple[int, ...],
                    order: Optional[Sequence[int]] = None):
         """Object ndarray of devices in ``order`` (default canonical),

@@ -831,6 +831,75 @@ class FFModel:
         return {k: jnp.zeros((G,) + v.shape, v.dtype).at[slot].set(v)
                 for k, v in st.items()}
 
+    def place_state(self, params, state, opt_state=None):
+        """Place concrete FULL (plain-layout) param/state/opt trees onto
+        this model's machine exactly as :meth:`init` would place freshly
+        initialized ones — block-/set-resident registry entries land in
+        their stacked storage, everything else on its op's sharding, state
+        defaulting to replicated.  The landing half of elastic live-state
+        migration (utils/elastic.py): the old model's member views
+        reassemble per-op trees on host, this places them on the new
+        (surviving) mesh.  Returns ``(params, state, opt_state)``."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        if self.machine.num_devices > 1:
+            self._placement_schedule(frozenset())
+        block = getattr(self, "_block_params", {})
+        block_state = getattr(self, "_block_state", {})
+
+        def stack(tree, slot, G, sh):
+            return {k: jax.device_put(
+                jnp.zeros((G,) + tuple(np.shape(v)),
+                          np.asarray(v).dtype).at[slot].set(v), sh[k])
+                for k, v in tree.items()}
+
+        def place_keyed(tree):
+            out = {}
+            for op in self.layers:
+                key = op.param_key
+                if key not in (tree or {}) or key in out:
+                    continue
+                p = tree[key]
+                bp = block.get(key)
+                if p and bp and bp.get("family") == "set":
+                    sh = self._block_sharding(bp)
+                    out[key] = {k: jax.device_put(v, sh[k])
+                                for k, v in _point_rows(p, bp).items()}
+                elif p and bp:
+                    out[key] = stack(p, bp["slot"], bp["G"],
+                                     self._block_sharding(bp))
+                elif p:
+                    with self._honored_ctx():
+                        sh = op.param_shardings(self.machine)
+                    out[key] = {k: jax.device_put(v, sh[k]) if k in sh
+                                else jax.device_put(v)
+                                for k, v in p.items()}
+            return out
+
+        placed_p = place_keyed(params)
+        placed_o = place_keyed(opt_state) if opt_state else {}
+        placed_s: Dict[str, Dict] = {}
+        repl = self.machine.replicated() if state else None
+        for op in self.layers:
+            nm = op.name
+            if nm not in (state or {}) or nm in placed_s:
+                continue
+            st = state[nm]
+            bs = block_state.get(nm)
+            if st and bs and bs.get("family") == "set":
+                sh = self._block_sharding(bs)
+                placed_s[nm] = {k: jax.device_put(v, sh[k])
+                                for k, v in _point_rows(st, bs).items()}
+            elif st and bs:
+                placed_s[nm] = stack(st, bs["slot"], bs["G"],
+                                     self._block_sharding(bs))
+            elif st:
+                placed_s[nm] = {k: jax.device_put(jnp.asarray(v), repl)
+                                for k, v in st.items()}
+        return placed_p, placed_s, placed_o
+
     def _honored_ctx(self):
         return self.machine.honored_placements(
             getattr(self, "_honored_pcs", ()))
@@ -1213,8 +1282,14 @@ class FFModel:
     # training loop (cnn.cc:110-128 parity: timed loop printing images/s)
 
     def fit(self, data_iter, num_iterations: Optional[int] = None,
-            warmup: int = 1, log=print):
+            warmup: int = 1, log=print, rebuild=None):
+        """Train for ``num_iterations``.  ``rebuild(config, machine)`` is
+        the optional model factory elastic recovery uses to reconstruct
+        the graph on a surviving mesh after permanent device loss
+        (``--elastic``, utils/elastic.py) — the drivers pass their
+        builder; without it a device loss is fatal."""
         from flexflow_tpu import obs
+        from flexflow_tpu.utils import elastic as _elastic
         from flexflow_tpu.utils import faultinject
 
         num_iterations = num_iterations or self.config.num_iterations
@@ -1237,14 +1312,46 @@ class FFModel:
         inj = faultinject.from_config(self.config, olog=olog)
         prev_inj = faultinject.install(inj) if inj.enabled else None
         try:
-            return self._fit(data_iter, num_iterations, warmup, log,
-                             olog, inj)
+            # elastic outer loop (utils/elastic.py): each detected
+            # permanent device loss shrinks onto the surviving mesh and
+            # CONTINUES the same logical run on the rebuilt model —
+            # prior losses are carried so callers see one history
+            model = self
+            carry = None
+            resizes = 0
+            prior_losses: List[float] = []
+            while True:
+                try:
+                    out = model._fit(data_iter, num_iterations, warmup,
+                                     log, olog, inj,
+                                     elastic_resume=carry,
+                                     elastic_resizes=resizes)
+                    if prior_losses:
+                        out["loss"] = prior_losses + out["loss"]
+                    out["elastic_resizes"] = resizes
+                    out["devices"] = model.machine.num_devices
+                    return out
+                except _elastic.DeviceLossDetected as sig:
+                    model, carry, kept = _elastic.recover(
+                        model, sig, rebuild, olog=olog, log=log)
+                    prior_losses = prior_losses + kept
+                    resizes += 1
+        except BaseException:
+            # error exit must release the multi-host coordinator promptly
+            # — a crashed host previously held the barrier until the
+            # other hosts' timeout (no-op unless THIS process initialized
+            # jax.distributed)
+            from flexflow_tpu import distributed
+
+            distributed.release()
+            raise
         finally:
             if prev_inj is not None:
                 faultinject.install(prev_inj)
             olog.close()
 
-    def _fit(self, data_iter, num_iterations, warmup, log, olog, inj):
+    def _fit(self, data_iter, num_iterations, warmup, log, olog, inj,
+             elastic_resume=None, elastic_resizes=0):
         import contextlib
 
         import jax
@@ -1282,7 +1389,18 @@ class FFModel:
         resumed = False
         ckpt_dir = getattr(self.config, "ckpt_dir", "")
         ckpt_freq = getattr(self.config, "ckpt_freq", 0)
-        if ckpt_dir:
+        if elastic_resume is not None:
+            # continuation after an elastic resize (utils/elastic.py):
+            # state arrives already placed on THIS model's surviving
+            # mesh; the data stream is NOT rewound — like rollback, the
+            # resumed steps consume fresh batches
+            start_iter = int(elastic_resume["start_iter"])
+            params = elastic_resume["params"]
+            state = elastic_resume["state"]
+            opt_state = elastic_resume["opt_state"] \
+                or self.init_opt_state(params)
+            resumed = True
+        elif ckpt_dir:
             if ckpt.latest_step(ckpt_dir) is not None:
                 t0 = time.perf_counter()
                 # verified restore with latest -> older fallback cascade
@@ -1316,6 +1434,21 @@ class FFModel:
         if not resumed:
             params, state = self.init()
             opt_state = self.init_opt_state(params)
+        # async checkpointing (utils/checkpoint.AsyncCheckpointWriter):
+        # serialization + digest + fsync'd commit move to a background
+        # writer; only the host snapshot stays on the boundary.  fit
+        # blocks on it only at the final save and before a rollback
+        # restore.  Off by default (--ckpt-async) — the sync path below
+        # is unchanged.
+        awriter = None
+        if ckpt_dir and getattr(self.config, "ckpt_async", False):
+            awriter = ckpt.AsyncCheckpointWriter(olog=olog, log=log)
+        # elastic device-loss bookkeeping (utils/elastic.py): injected
+        # ``device_loss`` fires mark ordinals dead here; detection is
+        # deferred to the next host-sync boundary (zero new syncs), where
+        # _raise_device_loss turns them into recovery or a fatal error
+        elastic_dead: List[int] = []
+        transient_retries = 0
         # double-buffered device prefetch (data/prefetch.py): host batch
         # prep + sharded H2D of step N+1 overlap step N's compute instead
         # of running synchronously inside the timed loop.  Wrapped AFTER
@@ -1390,108 +1523,182 @@ class FFModel:
         # of the guard's current loss window
         loss_base = start_iter
         window_start = start_iter
-        with trace_ctx:
-            it = start_iter
-            while it < num_iterations:
-                batch = next(data_iter)
-                if it == warmup:
-                    if loss is not None:
-                        float(loss)  # sync (block_until_ready is unreliable
-                                     # under the axon tunnel)
-                    start = time.perf_counter()
-                if sample_every and (it + 1) % sample_every == 0:
-                    params, state, opt_state, loss = self._sampled_step(
-                        step, sections, op_samples, it, loss,
-                        params, state, opt_state, batch)
-                else:
-                    params, state, opt_state, loss = step(
-                        params, state, opt_state, *batch)
-                if inj.enabled and inj.fire("loss_nan", site="fit"):
-                    # poison the RECORDED loss device-side (no host sync);
-                    # the guard must detect it at the next boundary
-                    loss = loss * float("nan")
-                losses.append(loss)
-                if clock is not None:
-                    clock.tick()
-                it1 = it + 1
-                at_print = bool(self.config.print_freq) \
-                    and it1 % self.config.print_freq == 0
-                at_ckpt = bool(ckpt_dir) and bool(ckpt_freq) \
-                    and it1 % ckpt_freq == 0 and it1 < num_iterations
-                if at_print or at_ckpt or it1 == num_iterations:
-                    # guard check rides boundaries that host-sync anyway
-                    # (print's float(loss), the save's device_get); the
-                    # boundary's own host time feeds the step_budget
-                    # host_sync bucket — timing existing work, not adding
-                    tb0 = time.perf_counter()
-                    action = guard.check(
-                        losses[window_start - loss_base:],
-                        first_step=window_start + 1)
-                    if action == "rollback":
-                        host_sync_s += time.perf_counter() - tb0
-                        rstep, params, state, opt_state = \
-                            self._rollback_restore(ckpt_dir, olog, log, it1)
-                        del losses[max(rstep - loss_base, 0):]
-                        loss_base = min(loss_base, rstep)
-                        loss = None
-                        window_start = rstep
-                        # the data stream is NOT rewound: steps re-run on
-                        # fresh batches, advancing past the bad window
-                        it = rstep
-                        continue
-                    window_start = it1
-                    host_sync_s += time.perf_counter() - tb0
-                if at_print:
-                    tb0 = time.perf_counter()
-                    log(f"iter {it1}: loss = {float(loss):.4f}")
-                    host_sync_s += time.perf_counter() - tb0
-                if at_ckpt:
-                    t0 = time.perf_counter()
+        try:
+            with trace_ctx:
+                it = start_iter
+                while it < num_iterations:
+                    batch = next(data_iter)
+                    if it == warmup:
+                        if loss is not None:
+                            float(loss)  # sync (block_until_ready is
+                            #              unreliable under the axon tunnel)
+                        start = time.perf_counter()
                     try:
-                        ckpt.save_checkpoint(ckpt_dir, it1, params, state,
-                                             opt_state,
-                                             self.config.strategies)
-                        dt = time.perf_counter() - t0
-                        ckpt_io_s += dt
-                        olog.event("checkpoint_save", step=it1,
-                                   seconds=dt, dir=ckpt_dir)
-                    except ckpt.NonFiniteCheckpointError as e:
-                        # never commit non-finite state over good
-                        # checkpoints; the guard decides the run's fate
-                        fault_count += 1
-                        ckpt_io_s += time.perf_counter() - t0
-                        olog.event("fault", source="checkpoint",
-                                   fault="nonfinite_state", step=it1,
-                                   error=str(e))
-                        log(f"warning: skipped checkpoint at iteration "
-                            f"{it1}: {e}")
-                if metrics is not None and (at_print or at_ckpt):
-                    # refresh the scrape at a boundary that just synced
-                    self._metrics_update(
-                        metrics, olog, step, params, state, opt_state,
-                        batch, losses, it1, warmup, start, guard,
-                        prefetcher, fault_count)
-                it += 1
-            if loss is not None:
-                float(loss)
-            elapsed = time.perf_counter() - start
+                        if sample_every and (it + 1) % sample_every == 0:
+                            params, state, opt_state, loss = \
+                                self._sampled_step(
+                                    step, sections, op_samples, it, loss,
+                                    params, state, opt_state, batch)
+                        else:
+                            params, state, opt_state, loss = step(
+                                params, state, opt_state, *batch)
+                        transient_retries = 0
+                    except Exception as e:
+                        # device-loss classification (utils/elastic.py):
+                        # a runtime error that probes TRANSIENT retries
+                        # this iteration on a fresh batch; PERMANENT loss
+                        # raises DeviceLossDetected (donated inputs are
+                        # unreachable -> checkpoint-fallback recovery)
+                        outcome = self._classify_step_error(
+                            e, it + 1, olog, losses, loss_base,
+                            transient_retries)
+                        if outcome != "transient":
+                            raise
+                        transient_retries += 1
+                        continue
+                    if inj.enabled and inj.fire("loss_nan", site="fit"):
+                        # poison the RECORDED loss device-side (no host
+                        # sync); the guard detects it at the next boundary
+                        loss = loss * float("nan")
+                    if inj.enabled and inj.fire("host_crash", site="fit"):
+                        from flexflow_tpu.utils.elastic import \
+                            HostCrashError
+
+                        raise HostCrashError(
+                            f"injected host crash at iteration {it + 1}")
+                    if inj.enabled and inj.fire("device_loss", site="fit"):
+                        # mark the highest live ordinal PERMANENTLY dead;
+                        # detection waits for the next host-sync boundary
+                        alive = [i for i in
+                                 range(self.machine.num_devices)
+                                 if i not in elastic_dead]
+                        if alive:
+                            elastic_dead.append(alive[-1])
+                    losses.append(loss)
+                    if clock is not None:
+                        clock.tick()
+                    it1 = it + 1
+                    at_print = bool(self.config.print_freq) \
+                        and it1 % self.config.print_freq == 0
+                    at_ckpt = bool(ckpt_dir) and bool(ckpt_freq) \
+                        and it1 % ckpt_freq == 0 and it1 < num_iterations
+                    if at_print or at_ckpt or it1 == num_iterations:
+                        # guard check rides boundaries that host-sync
+                        # anyway (print's float(loss), the save's
+                        # device_get); the boundary's own host time feeds
+                        # the step_budget host_sync bucket
+                        if elastic_dead:
+                            # injected permanent loss: hand the live loop
+                            # state to the elastic wrapper for recovery
+                            self._raise_device_loss(
+                                elastic_dead, it1, params, state,
+                                opt_state, losses, loss_base)
+                        tb0 = time.perf_counter()
+                        action = guard.check(
+                            losses[window_start - loss_base:],
+                            first_step=window_start + 1)
+                        if action == "rollback":
+                            host_sync_s += time.perf_counter() - tb0
+                            if awriter is not None:
+                                # the restore must see the newest commit
+                                awriter.wait()
+                            rstep, params, state, opt_state = \
+                                self._rollback_restore(ckpt_dir, olog,
+                                                       log, it1)
+                            del losses[max(rstep - loss_base, 0):]
+                            loss_base = min(loss_base, rstep)
+                            loss = None
+                            window_start = rstep
+                            # the data stream is NOT rewound: steps re-run
+                            # on fresh batches, past the bad window
+                            it = rstep
+                            continue
+                        window_start = it1
+                        host_sync_s += time.perf_counter() - tb0
+                    if at_print:
+                        tb0 = time.perf_counter()
+                        log(f"iter {it1}: loss = {float(loss):.4f}")
+                        host_sync_s += time.perf_counter() - tb0
+                    if at_ckpt:
+                        t0 = time.perf_counter()
+                        if awriter is not None:
+                            # async: only the host snapshot + enqueue stay
+                            # on the boundary; serialization/digest/commit
+                            # run on the background writer
+                            awriter.submit(ckpt_dir, it1, params, state,
+                                           opt_state,
+                                           self.config.strategies)
+                            ckpt_io_s += time.perf_counter() - t0
+                        else:
+                            try:
+                                ckpt.save_checkpoint(
+                                    ckpt_dir, it1, params, state,
+                                    opt_state, self.config.strategies)
+                                dt = time.perf_counter() - t0
+                                ckpt_io_s += dt
+                                olog.event("checkpoint_save", step=it1,
+                                           seconds=dt, dir=ckpt_dir)
+                            except ckpt.NonFiniteCheckpointError as e:
+                                # never commit non-finite state over good
+                                # checkpoints; the guard decides the
+                                # run's fate
+                                fault_count += 1
+                                ckpt_io_s += time.perf_counter() - t0
+                                olog.event("fault", source="checkpoint",
+                                           fault="nonfinite_state",
+                                           step=it1, error=str(e))
+                                log(f"warning: skipped checkpoint at "
+                                    f"iteration {it1}: {e}")
+                    if metrics is not None and (at_print or at_ckpt):
+                        # refresh the scrape at a boundary that just
+                        # synced
+                        self._metrics_update(
+                            metrics, olog, step, params, state, opt_state,
+                            batch, losses, it1, warmup, start, guard,
+                            prefetcher, fault_count, awriter=awriter,
+                            elastic_resizes=elastic_resizes)
+                    it += 1
+                if loss is not None:
+                    float(loss)
+                elapsed = time.perf_counter() - start
+        except BaseException:
+            # error exit (host crash, device loss handed to the elastic
+            # wrapper, genuine bug): stop the staging thread NOW — an
+            # elastic continuation re-wraps the same upstream iterator,
+            # and two live workers would interleave pulls — and abandon
+            # the async writer without blocking on its queue
+            if prefetcher is not None:
+                prefetcher.close()
+            if awriter is not None:
+                awriter.close(timeout=5.0)
+            raise
         if prefetcher is not None:
             # stop the staging thread before post-loop work; an
             # exceptional exit closes it via DevicePrefetcher.__del__
             prefetcher.close()
         if ckpt_dir and start_iter < num_iterations:
             t0 = time.perf_counter()
-            try:
-                ckpt.save_checkpoint(ckpt_dir, num_iterations, params,
-                                     state, opt_state,
-                                     self.config.strategies)
-                olog.event("checkpoint_save", step=num_iterations,
-                           seconds=time.perf_counter() - t0, dir=ckpt_dir)
-            except ckpt.NonFiniteCheckpointError as e:
-                olog.event("fault", source="checkpoint",
-                           fault="nonfinite_state", step=num_iterations,
-                           error=str(e))
-                log(f"warning: skipped final checkpoint: {e}")
+            if awriter is not None:
+                # the final save is the one write fit() blocks on: a
+                # returning run must leave a committed, verified state
+                awriter.submit(ckpt_dir, num_iterations, params, state,
+                               opt_state, self.config.strategies)
+                awriter.wait()
+            else:
+                try:
+                    ckpt.save_checkpoint(ckpt_dir, num_iterations, params,
+                                         state, opt_state,
+                                         self.config.strategies)
+                    olog.event("checkpoint_save", step=num_iterations,
+                               seconds=time.perf_counter() - t0,
+                               dir=ckpt_dir)
+                except ckpt.NonFiniteCheckpointError as e:
+                    olog.event("fault", source="checkpoint",
+                               fault="nonfinite_state",
+                               step=num_iterations, error=str(e))
+                    log(f"warning: skipped final checkpoint: {e}")
+        if awriter is not None:
+            awriter.close()
         # the one bulk device->host transfer of the whole loss history
         losses = [float(l) for l in jax.device_get(losses)]
         n_timed = num_iterations - warmup
@@ -1505,7 +1712,9 @@ class FFModel:
                                  opt_state, batch if losses else None,
                                  losses, num_iterations, warmup, start,
                                  guard, prefetcher, fault_count,
-                                 elapsed=elapsed, throughput=throughput)
+                                 elapsed=elapsed, throughput=throughput,
+                                 awriter=awriter,
+                                 elastic_resizes=elastic_resizes)
         if olog.enabled:
             budget_totals = {
                 "host_sync_s": host_sync_s, "checkpoint_s": ckpt_io_s,
@@ -1560,9 +1769,55 @@ class FFModel:
             "elapsed_s": elapsed, "images_per_sec": throughput,
             "input_stall_s": prefetcher.stall_s if prefetcher else 0.0,
             "rollbacks": guard.rollbacks,
+            "ckpt_async_saves": awriter.saves if awriter is not None
+            else 0,
             "run_id": olog.run_id, "obs_path": olog.path,
             "metrics_path": metrics.path if metrics is not None else "",
         }
+
+    def _raise_device_loss(self, dead, step, params, state, opt_state,
+                           losses, loss_base):
+        """Turn accumulated injected device losses into the elastic
+        wrapper's recovery signal (``--elastic``) or a fatal
+        :class:`~flexflow_tpu.utils.elastic.DeviceLostError`."""
+        from flexflow_tpu.utils import elastic
+
+        if getattr(self.config, "elastic", False):
+            raise elastic.DeviceLossDetected(
+                dead=dead, step=step, params=params, state=state,
+                opt_state=opt_state, losses=losses, loss_base=loss_base)
+        raise elastic.DeviceLostError(
+            f"permanent device loss at iteration {step} (ordinals "
+            f"{sorted(set(dead))}); run with --elastic to recover on "
+            f"the surviving mesh")
+
+    def _classify_step_error(self, e, step, olog, losses, loss_base,
+                             transient_retries):
+        """Elastic classification of a step-execution error: returns
+        ``"transient"`` when the device probe recovers (caller retries
+        the iteration on a fresh batch, bounded at 3 consecutive
+        retries), raises :class:`DeviceLossDetected` on permanent loss
+        (with ``params=None`` — the failed step's donated inputs are
+        unreachable, so recovery restores from checkpoint), and returns
+        None for anything that is not device loss (caller re-raises)."""
+        if not getattr(self.config, "elastic", False):
+            return None
+        from flexflow_tpu.utils import elastic
+
+        if not elastic.classify(e):
+            return None
+        live, dead, transient = elastic.probe_devices(self.machine,
+                                                      olog=olog)
+        if dead:
+            raise elastic.DeviceLossDetected(
+                dead=dead, step=step, params=None, state=None,
+                opt_state=None, losses=losses,
+                loss_base=loss_base) from e
+        if transient_retries >= 3:
+            return None  # persistent failure with healthy probes: a bug
+        olog.event("device_loss", step=step, classification="transient",
+                   transient=transient, error=str(e))
+        return "transient"
 
     def _rollback_restore(self, ckpt_dir, olog, log, from_step):
         """The health guard's rollback: restore the last VERIFIED
@@ -1726,7 +1981,8 @@ class FFModel:
     def _metrics_update(self, metrics, olog, step, params, state,
                         opt_state, batch, losses, it1, warmup, start_t,
                         guard, prefetcher, fault_count, elapsed=None,
-                        throughput=None):
+                        throughput=None, awriter=None,
+                        elastic_resizes=0):
         """Refresh and publish the live gauges (obs/metrics.py) at a
         boundary that already host-synced.  Every input is host-resident
         or memoized; the one potentially non-trivial call (compiled cost
@@ -1779,7 +2035,11 @@ class FFModel:
             prefetch_stall_seconds_total=(prefetcher.stall_s
                                           if prefetcher else 0.0),
             rollbacks_total=guard.rollbacks,
-            faults_total=fault_count)
+            faults_total=fault_count + (awriter.faults
+                                        if awriter is not None else 0),
+            elastic_events=elastic_resizes,
+            ckpt_async_inflight=(awriter.inflight
+                                 if awriter is not None else 0))
         try:
             metrics.write()
         except OSError as e:

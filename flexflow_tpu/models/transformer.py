@@ -60,6 +60,11 @@ class TransformerConfig:
     on_divergence: str = "halt"
     max_rollbacks: int = 3
     fault_spec: str = ""
+    # elastic training + async checkpointing (forwarded to FFConfig)
+    elastic: bool = False
+    min_devices: int = 1
+    research_budget_s: float = 30.0
+    ckpt_async: bool = False
 
 
 class TransformerLM(FFModel):
@@ -92,6 +97,10 @@ class TransformerLM(FFModel):
             on_divergence=self.t.on_divergence,
             max_rollbacks=self.t.max_rollbacks,
             fault_spec=self.t.fault_spec,
+            elastic=self.t.elastic,
+            min_devices=self.t.min_devices,
+            research_budget_s=self.t.research_budget_s,
+            ckpt_async=self.t.ckpt_async,
             strategies=strategies or Strategy(),
         )
         super().__init__(ff_cfg, machine)

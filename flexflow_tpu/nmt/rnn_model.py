@@ -71,6 +71,11 @@ class RnnConfig:
     on_divergence: str = "halt"
     max_rollbacks: int = 3
     fault_spec: str = ""
+    # elastic training + async checkpointing (forwarded to FFConfig)
+    elastic: bool = False
+    min_devices: int = 1
+    research_budget_s: float = 30.0
+    ckpt_async: bool = False
 
     @property
     def chunks_per_seq(self) -> int:
@@ -163,6 +168,10 @@ class RnnModel(FFModel):
             on_divergence=self.rnn.on_divergence,
             max_rollbacks=self.rnn.max_rollbacks,
             fault_spec=self.rnn.fault_spec,
+            elastic=self.rnn.elastic,
+            min_devices=self.rnn.min_devices,
+            research_budget_s=self.rnn.research_budget_s,
+            ckpt_async=self.rnn.ckpt_async,
             strategies=strategies,
         )
         super().__init__(ff_cfg, machine)

@@ -62,9 +62,13 @@ _HELP = {
     "rollbacks_total": "health-guard rollbacks this run",
     "faults_total": "fault records this run (injected, detected, or "
                     "refused-checkpoint)",
+    "elastic_events": "elastic resizes (surviving-mesh recoveries) this "
+                      "run",
+    "ckpt_async_inflight": "async checkpoint writes currently in flight "
+                           "(0 or 1)",
 }
 _COUNTERS = {"steps_total", "rollbacks_total", "faults_total",
-             "prefetch_stall_seconds_total"}
+             "prefetch_stall_seconds_total", "elastic_events"}
 
 
 def _finite(v) -> Optional[float]:

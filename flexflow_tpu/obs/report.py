@@ -177,6 +177,66 @@ def _fit_section(events: List[Dict]) -> List[str]:
     return lines
 
 
+def _elastic_section(events: List[Dict]) -> List[str]:
+    """The elastic-runtime records: device-loss detections/probes,
+    resizes (loss detected -> re-search time -> regrid bytes/hops ->
+    steps lost), fallbacks/refusals, rejoins, async checkpoint
+    commits."""
+    losses = [e for e in events if e.get("kind") == "device_loss"]
+    probes = [e for e in events if e.get("kind") == "device_probe"]
+    resizes = [e for e in events if e.get("kind") == "elastic_resize"]
+    fallbacks = [e for e in events if e.get("kind") == "elastic_fallback"]
+    refused = [e for e in events if e.get("kind") == "elastic_refused"]
+    rejoins = [e for e in events if e.get("kind") == "elastic_rejoin"]
+    asyncs = [e for e in events if e.get("kind") == "ckpt_async"]
+    if not (losses or resizes or fallbacks or refused or rejoins
+            or asyncs):
+        return []
+    lines = ["== elastic =="]
+    for d in losses:
+        what = (f"dead ordinals {d['dead']}" if d.get("dead")
+                else f"error {d.get('error', '?')!r}")
+        lines.append(f"  device_loss[{d.get('classification', '?')}] at "
+                     f"step {d.get('step', '?')}: {what} "
+                     f"({d.get('live', '?')} live)")
+    dead_probes = [p for p in probes if p.get("outcome") == "dead"]
+    trans_probes = [p for p in probes if p.get("outcome") == "transient"]
+    if probes:
+        lines.append(f"  probes: {len(dead_probes)} dead, "
+                     f"{len(trans_probes)} transient recoveries")
+    for f in fallbacks:
+        lines.append(f"  fallback to checkpoint at step "
+                     f"{f.get('step', '?')}: {f.get('reason', '?')}")
+    for r in refused:
+        lines.append(f"  REFUSED shrink at step {r.get('step', '?')}: "
+                     f"{r.get('live', '?')} live < min-devices "
+                     f"{r.get('min_devices', '?')}")
+    for r in resizes:
+        research = r.get("research") or {}
+        regrid = ""
+        if r.get("regrid_bytes") is not None:
+            regrid = (f", regrid {r['regrid_bytes'] / 1e6:.2f} MB / "
+                      f"{r.get('regrid_hops', 0)} hops")
+        lines.append(
+            f"  elastic_resize: {r.get('from_devices', '?')} -> "
+            f"{r.get('to_devices', '?')} devices at step "
+            f"{r.get('step', '?')} (re-search "
+            f"{_fmt_s(r.get('research_s', 0.0))} "
+            f"[{research.get('mode', '?')}], migration "
+            f"{r.get('migration', '?')}{regrid}, "
+            f"{r.get('steps_lost', 0)} step(s) lost)")
+    for r in rejoins:
+        lines.append(f"  rejoin: step {r.get('step', '?')} on "
+                     f"{r.get('devices', '?')} devices "
+                     f"(from {r.get('dir', '?')})")
+    if asyncs:
+        commits = sorted(float(a.get("commit_s", 0.0)) for a in asyncs)
+        lines.append(
+            f"  async checkpoints: {len(asyncs)} commits, median "
+            f"submit->commit {_fmt_s(commits[len(commits) // 2])}")
+    return lines
+
+
 def _fault_section(events: List[Dict]) -> List[str]:
     """The fault-tolerance records (robustness round): injected faults,
     guard detections, rollbacks, recoveries, data retries/skips,
@@ -361,7 +421,10 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "bench", "regrid_plan", "prefetch",
              "step_budget", "metrics",
              "fault", "rollback", "recovery", "data_fault",
-             "ckpt_fallback", "thread_leak"}
+             "ckpt_fallback", "thread_leak",
+             "device_loss", "device_probe", "elastic_resize",
+             "elastic_fallback", "elastic_refused", "elastic_rejoin",
+             "ckpt_async"}
     lines = []
     for e in events:
         kind = e.get("kind")
@@ -387,7 +450,8 @@ def render(events: Iterable[Dict]) -> str:
     if not events:
         return "(empty run log)"
     sections = [_header(events), _fit_section(events),
-                _fault_section(events), _search_section(events),
+                _fault_section(events), _elastic_section(events),
+                _search_section(events),
                 _audit_bench_section(events), _trace_section(events),
                 _misc_section(events)]
     return "\n".join("\n".join(s) for s in sections if s)
@@ -545,6 +609,40 @@ def summarize(events: Iterable[Dict]) -> Dict:
                                     "path")
                        and isinstance(v, (int, float))},
         }
+    elastic_kinds = ("device_loss", "device_probe", "elastic_resize",
+                     "elastic_fallback", "elastic_refused",
+                     "elastic_rejoin", "ckpt_async")
+    if any(kinds.get(k) for k in elastic_kinds):
+        el: Dict = {"counts": {k: kinds[k] for k in elastic_kinds
+                               if kinds.get(k)}}
+        resizes = [e for e in events if e.get("kind") == "elastic_resize"]
+        if resizes:
+            el["resizes"] = [
+                {"step": r.get("step"),
+                 "from_devices": r.get("from_devices"),
+                 "to_devices": r.get("to_devices"),
+                 "research_s": r.get("research_s"),
+                 "research_mode": (r.get("research") or {}).get("mode"),
+                 "migration": r.get("migration"),
+                 "regrid_bytes": r.get("regrid_bytes"),
+                 "regrid_hops": r.get("regrid_hops"),
+                 "steps_lost": r.get("steps_lost")} for r in resizes]
+        dl = [e for e in events if e.get("kind") == "device_loss"]
+        if dl:
+            el["device_losses"] = [
+                {"step": d.get("step"),
+                 "classification": d.get("classification"),
+                 "dead": d.get("dead")} for d in dl]
+        asyncs = [e for e in events if e.get("kind") == "ckpt_async"]
+        if asyncs:
+            commits = sorted(float(a.get("commit_s", 0.0))
+                             for a in asyncs)
+            el["ckpt_async"] = {
+                "commits": len(asyncs),
+                "median_commit_s": commits[len(commits) // 2],
+                "faults": max(int(a.get("faults", 0)) for a in asyncs),
+            }
+        out["elastic"] = el
     fault_kinds = ("fault", "rollback", "recovery", "data_fault",
                    "ckpt_fallback", "thread_leak")
     if any(kinds.get(k) for k in fault_kinds):

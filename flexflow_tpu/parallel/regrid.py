@@ -519,3 +519,89 @@ def build_regrid_plan(model, fusion: Dict, schedule) -> RegridPlan:
                 specs[t.tid] = machine.global_entries(
                     op.pc, op.AXIS_NAMES, spec, rank=t.ndim)
     return plan
+
+
+# ---------------------------------------------------------------------------
+# live-state migration accounting (elastic resize)
+
+
+def plan_state_migration(old_model, new_model, params: Dict,
+                         state: Optional[Dict] = None,
+                         opt_state: Optional[Dict] = None) -> Dict:
+    """Accounting plan for moving live train state between two MACHINES
+    (the elastic runtime's 8->6 shrink, utils/elastic.py) — the
+    cross-machine sibling of :class:`RegridPlan`.
+
+    A resize cannot be expressed as in-mesh hops: no mesh spans the old
+    and new device sets at once, so every leaf is gathered off its source
+    layout (one hop, priced as the all-gather of its replicated form on
+    the OLD machine's links) and re-placed sharded on the new layout (one
+    hop, the sharded put's per-device slice traffic on the NEW machine —
+    a leaf landing replicated pays the full broadcast instead).  Leaves
+    whose source layout is already fully replicated skip the gather: a
+    surviving device holds the whole value.
+
+    Returns per-key rows plus the totals the ``elastic_resize`` obs
+    record carries (``bytes``, ``hops``, ``predicted_s``).  Pure
+    accounting — the actual movement is ``np.asarray`` + the new model's
+    placement (``FFModel.place_state``), and this plan never touches
+    device data."""
+    import numpy as np
+
+    from flexflow_tpu.sim.cost_model import dtype_bytes
+
+    old_n = old_model.machine.num_devices
+    new_n = new_model.machine.num_devices
+    new_topo = new_model.machine.topology
+    old_topo = old_model.machine.topology
+
+    def shard_count(model, key):
+        for op in model.layers:
+            if op.param_key == key or op.name == key:
+                return max(op.pc.num_parts, 1)
+        return 1
+
+    rows = []
+    total_bytes = 0.0
+    total_hops = 0
+    total_s = 0.0
+    trees = [("params", params)]
+    if state:
+        trees.append(("state", state))
+    if opt_state:
+        trees.append(("opt", opt_state))
+    for tree_name, tree in trees:
+        for key, sub in (tree or {}).items():
+            kb = 0.0
+            for leaf in (sub or {}).values():
+                a = np.asarray(leaf) if not hasattr(leaf, "nbytes") else leaf
+                kb += float(getattr(a, "size", 0)
+                            * dtype_bytes(str(getattr(a, "dtype",
+                                                      "float32"))))
+            src_parts = shard_count(old_model, key)
+            dst_parts = shard_count(new_model, key)
+            hops = 0
+            secs = 0.0
+            if src_parts > 1:
+                # gather the sharded source onto one surviving host copy:
+                # half an all-reduce of the full value over the old links
+                hops += 1
+                secs += 0.5 * _allreduce(kb, tuple(range(old_n)), old_topo)
+            if dst_parts > 1:
+                # sharded re-place: each new device receives its slice
+                hops += 1
+                secs += kb / dst_parts / new_topo.ici_bandwidth \
+                    + new_topo.ici_latency
+            else:
+                # replicated landing: full broadcast to every survivor
+                hops += 1
+                secs += 0.5 * _allreduce(kb, tuple(range(new_n)), new_topo)
+            rows.append({"tree": tree_name, "key": key, "bytes": kb,
+                         "src_parts": src_parts, "dst_parts": dst_parts,
+                         "hops": hops, "predicted_s": secs})
+            total_bytes += kb
+            total_hops += hops
+            total_s += secs
+    return {"keys": len(rows), "bytes": total_bytes, "hops": total_hops,
+            "predicted_s": total_s,
+            "from_devices": old_n, "to_devices": new_n, "rows": rows}

@@ -969,7 +969,9 @@ class StrategySearch:
 
     def search(self, iters: int = 250_000, beta: float = 5e3,
                seed: int = 0, chunks: int = 25, chains: int = 1,
-               delta: bool = True, delta_check: bool = False):
+               delta: bool = True, delta_check: bool = False,
+               start: Optional[Sequence[int]] = None,
+               budget_s: Optional[float] = None):
         """MCMC from the DP start point (reference: scripts/simulator.cc
         :1427-1471).  ``chains`` independent Metropolis chains advance
         concurrently on native threads (per-chain RNG derived from
@@ -986,24 +988,42 @@ class StrategySearch:
         proposal pays a full re-simulation); ``delta_check`` additionally
         cross-checks every delta against a full re-simulation and aborts
         on divergence (debug mode — per-proposal acceptance semantics are
-        identical either way).  Returns (strategy, info);
-        ``info["trace"]`` carries the per-(chunk, chain) trajectory for
-        programmatic callers."""
+        identical either way).  ``start`` warm-starts every chain from a
+        given assignment instead of the DP point (the elastic runtime
+        seeds the surviving-mesh re-search with the running strategy,
+        dead-device entries already invalidated to DP); ``budget_s``
+        caps the search WALL CLOCK — chunks stop once the budget is
+        spent, so a mid-run re-search is bounded regardless of graph
+        size (the best-so-far state is returned, never nothing).
+        Returns (strategy, info); ``info["trace"]`` carries the
+        per-(chunk, chain) trajectory for programmatic callers."""
         import time as _time
 
         dp = self.dp_assignment()
         dp_time = self.simulate(dp)
+        init = list(start) if start is not None else list(dp)
+        if len(init) != len(self.ops):
+            raise ValueError(
+                f"warm-start assignment has {len(init)} entries for "
+                f"{len(self.ops)} ops")
         chains = max(1, int(chains))
         self.sim.set_delta(delta)
         self.sim.set_crosscheck(delta_check)
         chunks = max(1, min(int(chunks), max(iters, 1)))
-        curs = [list(dp) for _ in range(chains)]
-        bests = [list(dp) for _ in range(chains)]
+        curs = [list(init) for _ in range(chains)]
+        bests = [list(init) for _ in range(chains)]
         times = [[-1.0, -1.0] for _ in range(chains)]
         trace = []
         tot_acc = tot_prop = tot_delta = tot_full = done = 0
         tot_wall = 0.0
+        budget_hit = False
+        t_start = _time.perf_counter()
         for ci in range(chunks):
+            if budget_s is not None \
+                    and _time.perf_counter() - t_start >= budget_s \
+                    and done > 0:
+                budget_hit = True
+                break
             it_n = iters // chunks + (1 if ci < iters % chunks else 0)
             if it_n <= 0:
                 continue
@@ -1046,8 +1066,8 @@ class StrategySearch:
                     if i != gb and times[gb][1] < times[i][0]:
                         curs[i] = list(bests[gb])
                         times[i][0] = times[gb][1]
-        if done == 0:  # iters <= 0: the DP start point is the answer
-            best, best_t = list(dp), self.sim.simulate(dp)
+        if done == 0:  # iters <= 0: the start point is the answer
+            best, best_t = list(init), self.sim.simulate(init)
         else:
             gb = min(range(chains), key=lambda i: (times[i][1], i))
             best, best_t = bests[gb], times[gb][1]
@@ -1065,9 +1085,12 @@ class StrategySearch:
             "delta": delta,
             "delta_hit_rate": tot_delta / evals if evals else 0.0,
             "proposals_per_sec": tot_prop / tot_wall if tot_wall > 0 else 0.0,
+            "iters_done": done,
+            "budget_hit": budget_hit,
         }
         result = {"dp_time_s": dp_time, "best_time_s": best_time,
                   "speedup_vs_dp": info["speedup_vs_dp"], "iters": done,
+                  "budget_hit": budget_hit,
                   "accepted": tot_acc, "proposed": tot_prop,
                   "accept_rate": info["accept_rate"], "seed": seed,
                   "beta": beta, "chains": chains, "delta": delta,

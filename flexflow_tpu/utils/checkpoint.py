@@ -402,6 +402,154 @@ def restore_checkpoint(ckpt_dir: str, model=None,
         + "; ".join(f"step {fs}: {fw}" for fs, fw in failures))
 
 
+def snapshot_tree(tree: Dict) -> Dict:
+    """Host-side deep copy of a (possibly device-resident) checkpoint
+    tree: every leaf materialized as a plain numpy array.  This is the
+    async writer's consistency point — the copy happens at the caller's
+    host-sync boundary, so the background serialization can never
+    observe a leaf the NEXT training step has already donated/mutated."""
+    out: Dict = {}
+    for k, v in (tree or {}).items():
+        # np.array(copy=True): np.asarray of a HOST array is a view, and
+        # a view is exactly the torn-snapshot hazard this exists to close
+        out[k] = snapshot_tree(v) if isinstance(v, dict) \
+            else np.array(v, copy=True)
+    return out
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint committer: serialization, digest computation
+    and the fsync'd atomic directory commit run on ONE worker thread, off
+    the training step's critical path.
+
+    Contract (robustness round, elastic tentpole):
+
+      * ``submit()`` snapshots the device trees to host numpy at the
+        call site (the only part that must happen at the sync boundary —
+        the next step donates those buffers) and enqueues the write; at
+        most ONE save is in flight, so a submit that arrives while the
+        previous write is still running first waits for it (this only
+        costs anything when a write is slower than a checkpoint
+        interval);
+      * the committed bytes are BIT-IDENTICAL to a synchronous
+        :func:`save_checkpoint` of the same state — the worker calls the
+        exact same function on the snapshot;
+      * a worker-side :class:`NonFiniteCheckpointError` (or any other
+        save failure) never kills the run: it is counted in ``faults``,
+        logged, and emitted as a ``fault`` obs record, exactly like the
+        synchronous path's handling;
+      * ``wait()`` blocks until the queue is drained — fit() calls it
+        before a rollback restore (the restore must see the newest
+        commit) and at the final save; ``close()`` waits and joins.
+
+    ``inflight`` (0 or 1) is exported as the ``ff_ckpt_async_inflight``
+    gauge.  Every completed write emits a ``ckpt_async`` obs record with
+    the submit->commit latency so the overlap is auditable."""
+
+    def __init__(self, olog=None, log=None, keep: int = 3,
+                 require_finite: bool = True):
+        import queue
+        import threading
+
+        from flexflow_tpu import obs
+
+        self.olog = olog if olog is not None else obs.NULL
+        self.log = log or (lambda *a: None)
+        self.keep = keep
+        self.require_finite = require_finite
+        self.inflight = 0
+        self.saves = 0
+        self.faults = 0
+        self.last_step: Optional[int] = None
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._worker, name="ff-ckpt-async", daemon=True)
+        self._thread.start()
+
+    # -- producer side (the training loop) ---------------------------
+
+    def submit(self, ckpt_dir: str, step: int, params, state, opt_state,
+               strategy=None) -> None:
+        """Snapshot + enqueue one checkpoint write.  Blocks only if the
+        PREVIOUS write has not finished (one in flight, ever)."""
+        self.wait()
+        import time as _time
+
+        job = {
+            "dir": ckpt_dir, "step": int(step),
+            "params": snapshot_tree(params),
+            "state": snapshot_tree(state),
+            "opt": snapshot_tree(opt_state),
+            "strategy": strategy, "t_submit": _time.perf_counter(),
+        }
+        with self._lock:
+            self.inflight += 1
+        self._idle.clear()
+        self._q.put(job)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until no write is in flight.  True when drained."""
+        return self._idle.wait(timeout=timeout)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain, then stop and join the worker.  Idempotent."""
+        self.wait(timeout=timeout)
+        if self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join(timeout=timeout or 10.0)
+
+    # -- worker side --------------------------------------------------
+
+    def _worker(self):
+        import time as _time
+
+        while True:
+            job = self._q.get()
+            if job is None:
+                self._idle.set()
+                return
+            try:
+                try:
+                    save_checkpoint(job["dir"], job["step"], job["params"],
+                                    job["state"], job["opt"],
+                                    job["strategy"], keep=self.keep,
+                                    require_finite=self.require_finite)
+                    dt = _time.perf_counter() - job["t_submit"]
+                    with self._lock:
+                        self.saves += 1
+                        self.last_step = job["step"]
+                    self.olog.event("checkpoint_save", step=job["step"],
+                                    seconds=dt, dir=job["dir"],
+                                    mode="async")
+                    self.olog.event("ckpt_async", step=job["step"],
+                                    commit_s=dt, saves=self.saves,
+                                    faults=self.faults)
+                except NonFiniteCheckpointError as e:
+                    with self._lock:
+                        self.faults += 1
+                    self.olog.event("fault", source="checkpoint",
+                                    fault="nonfinite_state",
+                                    step=job["step"], error=str(e))
+                    self.log(f"warning: skipped async checkpoint at "
+                             f"iteration {job['step']}: {e}")
+                except Exception as e:  # never kill the run from here
+                    with self._lock:
+                        self.faults += 1
+                    self.olog.event("fault", source="checkpoint",
+                                    fault="async_save_failed",
+                                    step=job["step"], error=str(e))
+                    self.log(f"warning: async checkpoint at iteration "
+                             f"{job['step']} failed: {e}")
+            finally:
+                with self._lock:
+                    self.inflight -= 1
+                    if self.inflight == 0:
+                        self._idle.set()
+
+
 def load_strategy(ckpt_dir: str, step: Optional[int] = None):
     """The Strategy a checkpoint was trained under, or None."""
     from flexflow_tpu.strategy import Strategy

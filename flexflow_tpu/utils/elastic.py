@@ -1,0 +1,436 @@
+"""Elastic training runtime: device-loss recovery on the surviving mesh.
+
+PR 5's fault runtime made one process survive NaNs, bad bytes and corrupt
+checkpoints; a permanently lost device (or a crashed host) still killed
+the whole run.  This module turns that loss into a recoverable, observable
+event, built on the subsystems that make elasticity cheap here:
+
+  * the native MCMC search with delta re-simulation (sim/search.py) can
+    re-search a strategy for the SURVIVING mesh in seconds, warm-started
+    from the running strategy with dead-device assignments invalidated;
+  * the regrid planner's cost view (parallel/regrid.py,
+    ``plan_state_migration``) prices moving the live params/opt-state
+    onto the new layout;
+  * verified checkpoints (utils/checkpoint.py) are the fallback when the
+    in-memory state is unreachable (donated buffers, state resident on
+    the dead device).
+
+The pieces, in the order a loss flows through them:
+
+  1. **detection & classification** — ``fit()`` catches runtime errors at
+     its EXISTING host-sync boundaries (the same zero-new-syncs
+     discipline as ``StepHealthGuard``) and asks :func:`classify` whether
+     they look like device loss; :func:`probe_devices` then re-probes
+     every device with bounded backoff (utils/retry.py), splitting
+     TRANSIENT hiccups (probe recovers — training continues) from
+     PERMANENT loss (probe exhausts its attempts — recovery starts).
+     The injected path (``device_loss@N`` in utils/faultinject.py) marks
+     devices dead deterministically so CI exercises every branch;
+  2. **recovery** (:func:`recover`) — shrink the machine to the live
+     devices (``MachineModel.shrink``), rebuild the model graph on it
+     (the driver's ``rebuild(config, machine)`` factory), re-search a
+     strategy under ``--research-budget-s`` wall clock, then migrate the
+     live state (:func:`gather_state` -> ``FFModel.place_state``) or
+     restore the newest verified checkpoint onto the new mesh.  Exactly
+     one ``elastic_resize`` obs record per event carries the whole story:
+     loss detected -> re-search time -> regrid bytes/hops -> steps lost;
+  3. **refusal** — shrinking below ``--min-devices`` raises
+     :class:`ElasticShrinkRefused` instead of limping (a 2-device
+     remnant of a 256-chip job is an outage, not a run).
+
+``host_crash@N`` injection raises :class:`HostCrashError` mid-step,
+exercising fit()'s error-exit cleanup (coordinator release via
+``distributed.release`` — a crashed host must not hold the barrier until
+timeout) and the ``--elastic`` restart protocol
+(``distributed.elastic_rejoin``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.utils.retry import RetryPolicy, call_with_retry
+
+
+class DeviceLostError(RuntimeError):
+    """Permanent device loss that the run cannot (or may not) recover
+    from: elasticity disabled, no usable state, or probe exhaustion with
+    no recovery path."""
+
+
+class HostCrashError(RuntimeError):
+    """An injected ``host_crash`` fault: this process is simulated as
+    dying mid-run.  Propagates out of fit() through the error-exit
+    cleanup (coordinator release, prefetcher shutdown)."""
+
+
+class ElasticShrinkRefused(RuntimeError):
+    """The surviving mesh is smaller than ``--min-devices``."""
+
+    def __init__(self, live: int, min_devices: int, dead: Sequence[int]):
+        self.live = live
+        self.min_devices = min_devices
+        self.dead = list(dead)
+        super().__init__(
+            f"device loss left {live} live device(s) (lost ordinals "
+            f"{sorted(self.dead)}), below --min-devices {min_devices}; "
+            f"refusing to continue on the remnant")
+
+
+class DeviceLossDetected(Exception):
+    """Internal control-flow signal: fit()'s loop raises it at a host-sync
+    boundary once permanent loss is established; fit()'s elastic wrapper
+    catches it and runs :func:`recover`.  Carries everything recovery
+    needs — the dead ordinals and the loop's live state (``params`` may
+    be None when the step's donated buffers are unreachable)."""
+
+    def __init__(self, dead: Sequence[int], step: int, params=None,
+                 state=None, opt_state=None, losses=(), loss_base: int = 0):
+        self.dead = sorted(set(int(d) for d in dead))
+        self.step = int(step)
+        self.params = params
+        self.state = state
+        self.opt_state = opt_state
+        self.losses = list(losses)
+        self.loss_base = int(loss_base)
+        super().__init__(
+            f"permanent device loss at step {step}: ordinals {self.dead}")
+
+
+# substrings (lowercased) of runtime errors that indicate the DEVICE —
+# not the program — failed.  Conservative: a miss means the error
+# propagates like any other bug, which is the safe default.
+_LOSS_PATTERNS = (
+    "device_unavailable",
+    "device unavailable",
+    "device lost",
+    "device failure",
+    "device is in an error state",
+    "hardware failure",
+    "chip unreachable",
+    "slice health",
+    "halted with",
+    "tpu is in an invalid state",
+    "failed to connect to device",
+    "data transfer failure",
+    "ici link",
+)
+
+# exception type names the XLA runtime raises device failures through
+_LOSS_TYPES = ("XlaRuntimeError", "JaxRuntimeError", "InternalError",
+               "UnavailableError")
+
+
+def classify(exc: BaseException) -> bool:
+    """Does ``exc`` look like a device/runtime loss (vs an ordinary
+    program bug)?  True -> the caller should probe the devices;
+    False -> re-raise, this is not elasticity's problem."""
+    if isinstance(exc, (DeviceLostError, DeviceLossDetected)):
+        return True
+    if type(exc).__name__ not in _LOSS_TYPES:
+        return False
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(p in text for p in _LOSS_PATTERNS)
+
+
+def _default_probe(device) -> None:
+    """One tiny host->device->host round trip; raises on a dead device."""
+    import jax
+    import numpy as np
+
+    x = jax.device_put(np.ones((), np.float32), device)
+    float(np.asarray(x))
+
+
+def probe_devices(machine, policy: Optional[RetryPolicy] = None,
+                  probe=None, olog=None,
+                  sleep=time.sleep) -> Tuple[List[int], List[int],
+                                             List[int]]:
+    """Re-probe every device of ``machine`` with bounded backoff and
+    split the outcome: ``(live, dead, transient)`` ordinal lists, where
+    ``transient`` is the subset of ``live`` that failed at least once
+    before recovering.  ``probe(device)`` raising marks one failed
+    attempt; the policy bounds total attempts per device (default 3
+    attempts, short deterministic backoff — a genuinely dead device
+    costs well under a second to condemn)."""
+    from flexflow_tpu import obs
+
+    olog = olog if olog is not None else obs.NULL
+    policy = policy or RetryPolicy(attempts=3, base_delay=0.05,
+                                   max_delay=0.5)
+    probe = probe or _default_probe
+    live: List[int] = []
+    dead: List[int] = []
+    transient: List[int] = []
+    for i, dev in enumerate(machine.devices):
+        failures = {"n": 0}
+
+        def on_retry(exc, n, delay, _f=failures):
+            _f["n"] = n
+
+        try:
+            call_with_retry(lambda d=dev: probe(d), policy=policy,
+                            retry_on=(Exception,), on_retry=on_retry,
+                            sleep=sleep)
+        except Exception as e:
+            dead.append(i)
+            olog.event("device_probe", device=i, outcome="dead",
+                       attempts=policy.attempts, error=str(e))
+            continue
+        live.append(i)
+        if failures["n"]:
+            transient.append(i)
+            olog.event("device_probe", device=i, outcome="transient",
+                       failures=failures["n"])
+    return live, dead, transient
+
+
+# ---------------------------------------------------------------------------
+# recovery
+
+
+def _reassemble_trees(model, params, state, opt_state) -> Tuple[Dict,
+                                                                Dict,
+                                                                Dict]:
+    """(params, state, opt) as FULL logical host trees: every block-/
+    set-resident leaf reassembled to its op's plain layout via ``model``'s
+    member views, then materialized as numpy.  Works on live device trees
+    AND on raw checkpoint trees saved by ``model`` (the storage layout is
+    the model's registry either way)."""
+    import numpy as np
+
+    full_p: Dict = {}
+    full_s: Dict = {}
+    full_o: Dict = {}
+    for op in model.layers:
+        key = op.param_key
+        if key in (params or {}) and key not in full_p:
+            full_p[key] = {k: np.asarray(v) for k, v in
+                           model._member_params(params, op).items()}
+            if opt_state and key in opt_state:
+                full_o[key] = {k: np.asarray(v) for k, v in
+                               model._member_params(opt_state, op).items()}
+        if op.name in (state or {}) and op.name not in full_s:
+            full_s[op.name] = {k: np.asarray(v) for k, v in
+                               model._member_state(state, op).items()}
+    return full_p, full_s, full_o
+
+
+def gather_state(model, params, state, opt_state) -> Tuple[Dict, Dict,
+                                                           Dict]:
+    """Pull the LIVE train state to host as full logical trees.  Raises
+    when any leaf is unreachable (buffer donated by a failed step, or
+    resident on a dead device) — the caller falls back to checkpoint
+    restore."""
+    return _reassemble_trees(model, params, state, opt_state)
+
+
+def warm_assignment(search, strategy) -> List[int]:
+    """Candidate index per op seeding the surviving-mesh re-search from
+    the RUNNING strategy: entries whose (dims, devices) survive among the
+    op's candidates on the new machine keep their config; everything else
+    — dead-device placements, grids the smaller machine cannot host —
+    falls back to the DP default (the invalidation the tentpole names)."""
+    from flexflow_tpu.sim.search import _InputSource
+
+    dp = search.dp_assignment()
+    out = []
+    kept = 0
+    for op, cands, dflt in zip(search.ops, search.candidates, dp):
+        idx = dflt
+        if not isinstance(op, _InputSource) and strategy is not None:
+            pc = strategy.get(op.name)
+            if pc is not None:
+                for i, c in enumerate(cands):
+                    if c.dims == pc.dims and c.devices == pc.devices:
+                        idx = i
+                        kept += 1
+                        break
+        out.append(idx)
+    return out
+
+
+def research_strategy(config, rebuild, new_machine, old_strategy,
+                      olog=None, log=print):
+    """Re-run the native MCMC search for the surviving mesh under the
+    ``--research-budget-s`` wall clock, warm-started from the running
+    strategy.  Degrades gracefully: when the native simulator (or the
+    search itself) is unavailable, the surviving mesh trains pure-DP —
+    a correct plan, just not a searched one.  Returns
+    ``(Strategy, info dict)``; ``info["mode"]`` is ``"mcmc"`` or
+    ``"dp_fallback"``."""
+    import copy
+
+    from flexflow_tpu.strategy import Strategy
+
+    budget = float(getattr(config, "research_budget_s", 30.0) or 30.0)
+    iters = int(getattr(config, "elastic_search_iters", 2000) or 2000)
+    try:
+        from flexflow_tpu.sim.search import StrategySearch
+
+        shell_cfg = copy.copy(config)
+        shell_cfg.strategies = Strategy()
+        shell = rebuild(shell_cfg, new_machine)
+        ss = StrategySearch(shell, machine=new_machine, obs=olog)
+        start = warm_assignment(ss, old_strategy) \
+            if old_strategy is not None and len(old_strategy) else None
+        strategy, info = ss.search(
+            iters=iters, seed=int(getattr(config, "seed", 0)),
+            chunks=8, chains=max(int(getattr(config, "search_chains", 1)),
+                                 1),
+            delta=getattr(config, "search_delta", "on") != "off",
+            start=start, budget_s=budget)
+        return strategy, {"mode": "mcmc",
+                          "best_time_s": info.get("best_time"),
+                          "iters": info.get("iters_done"),
+                          "budget_hit": info.get("budget_hit", False),
+                          "budget_s": budget}
+    except Exception as e:
+        log(f"elastic: surviving-mesh re-search unavailable ({e}); "
+            f"continuing pure-DP on {new_machine.num_devices} devices")
+        return Strategy(), {"mode": "dp_fallback", "error": str(e),
+                            "budget_s": budget}
+
+
+def recover(model, sig: DeviceLossDetected, rebuild, olog=None,
+            log=print):
+    """Full surviving-mesh recovery for one detected permanent loss.
+
+    Returns ``(new_model, carry, prior_losses)``:
+
+      * ``new_model`` — rebuilt on the shrunk machine under the
+        re-searched strategy, its state placed and ready to train;
+      * ``carry`` — the ``_fit`` elastic-resume dict (start iteration +
+        placed state + resize count);
+      * ``prior_losses`` — host floats of the completed steps that REMAIN
+        valid after recovery (trimmed when a checkpoint fallback rewinds
+        past them), for the caller's loss-continuity bookkeeping.
+
+    Emits exactly ONE ``elastic_resize`` record per call (plus the
+    ``device_loss`` detection record and, on the fallback path, an
+    ``elastic_fallback`` record)."""
+    import copy
+
+    import jax
+
+    from flexflow_tpu import obs
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    olog = olog if olog is not None else obs.NULL
+    t0 = time.perf_counter()
+    cfg = model.config
+    n_old = model.machine.num_devices
+    dead = set(sig.dead)
+    live = [i for i in range(n_old) if i not in dead]
+    min_devices = max(int(getattr(cfg, "min_devices", 1) or 1), 1)
+    olog.event("device_loss", step=sig.step, classification="permanent",
+               dead=sorted(dead), live=len(live), devices=n_old)
+    log(f"elastic: permanent device loss at iteration {sig.step} — "
+        f"ordinals {sorted(dead)} dead, {len(live)}/{n_old} surviving")
+    if len(live) < min_devices:
+        olog.event("elastic_refused", step=sig.step, live=len(live),
+                   min_devices=min_devices, dead=sorted(dead))
+        raise ElasticShrinkRefused(len(live), min_devices, sorted(dead))
+    if rebuild is None:
+        raise DeviceLostError(
+            "elastic recovery needs a model factory: pass "
+            "rebuild=lambda cfg, machine: <build model> to fit() "
+            "(the drivers do)")
+    new_machine = model.machine.shrink(live)
+
+    # losses completed so far -> host floats (best effort: with a real
+    # dead device holding a loss shard this transfer itself can fail)
+    try:
+        prior = [float(v) for v in jax.device_get(list(sig.losses))]
+    except Exception:
+        prior = []
+
+    t_search = time.perf_counter()
+    strategy, research = research_strategy(
+        cfg, rebuild, new_machine,
+        getattr(cfg, "strategies", None), olog=olog, log=log)
+    research_s = time.perf_counter() - t_search
+
+    final_cfg = copy.copy(cfg)
+    final_cfg.strategies = strategy
+    try:
+        new_model = rebuild(final_cfg, new_machine)
+    except Exception as e:
+        # the graph cannot exist on the surviving mesh (e.g. the batch
+        # does not divide the survivor count) — recovery is impossible
+        raise DeviceLostError(
+            f"cannot rebuild the model on the {len(live)} surviving "
+            f"device(s): {e} (pick a batch size divisible by every "
+            f"survivable mesh, or raise --min-devices)") from e
+
+    migrated = False
+    fallback_reason = None
+    mig_plan = None
+    params = state = opt_state = None
+    if sig.params is not None:
+        try:
+            full_p, full_s, full_o = gather_state(
+                model, sig.params, sig.state, sig.opt_state)
+            from flexflow_tpu.parallel.regrid import plan_state_migration
+
+            mig_plan = plan_state_migration(model, new_model, full_p,
+                                            full_s, full_o)
+            params, state, opt_state = new_model.place_state(
+                full_p, full_s, full_o)
+            migrated = True
+        except Exception as e:
+            fallback_reason = str(e)
+    else:
+        fallback_reason = "live state unreachable (step failed with " \
+                          "donated buffers)"
+
+    if migrated:
+        resume_step = sig.step
+        steps_lost = 0
+    else:
+        olog.event("elastic_fallback", step=sig.step,
+                   reason=fallback_reason)
+        log(f"elastic: in-memory migration unavailable "
+            f"({fallback_reason}); restoring the newest verified "
+            f"checkpoint onto the {len(live)}-device mesh")
+        ckpt_dir = getattr(cfg, "ckpt_dir", "")
+        if not ckpt_dir:
+            raise DeviceLostError(
+                f"device loss at step {sig.step}: live state is "
+                f"unreachable ({fallback_reason}) and no --ckpt-dir is "
+                f"configured to restore from") from None
+        # raw load (no model placement): the checkpoint holds the OLD
+        # model's storage layout — reassemble to full trees through its
+        # registry, then land on the new mesh like the in-memory path
+        resume_step, raw_p, raw_s, raw_o = ckpt.restore_checkpoint(
+            ckpt_dir, None, olog=olog)
+        full_p, full_s, full_o = _reassemble_trees(model, raw_p, raw_s,
+                                                   raw_o)
+        params, state, opt_state = new_model.place_state(full_p, full_s,
+                                                         full_o)
+        opt_state = opt_state or new_model.init_opt_state(params)
+        steps_lost = max(sig.step - resume_step, 0)
+        # completed-loss history beyond the restore point replays
+        prior = prior[:max(resume_step - sig.loss_base, 0)]
+
+    rec = {
+        "step": sig.step, "from_devices": n_old,
+        "to_devices": len(live), "dead": sorted(dead),
+        "research_s": research_s, "research": research,
+        "migration": "in_memory" if migrated else "checkpoint",
+        "resume_step": resume_step, "steps_lost": steps_lost,
+        "total_s": time.perf_counter() - t0,
+    }
+    if mig_plan is not None:
+        rec["regrid_bytes"] = mig_plan["bytes"]
+        rec["regrid_hops"] = mig_plan["hops"]
+        rec["regrid_predicted_s"] = mig_plan["predicted_s"]
+    olog.event("elastic_resize", **rec)
+    log(f"elastic: resized {n_old} -> {len(live)} devices at iteration "
+        f"{sig.step} (re-search {research_s:.2f}s [{research['mode']}], "
+        f"migration {rec['migration']}, resume at {resume_step}, "
+        f"{steps_lost} step(s) lost)")
+    carry = {"start_iter": resume_step, "params": params, "state": state,
+             "opt_state": opt_state}
+    return new_model, carry, prior

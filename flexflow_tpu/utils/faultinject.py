@@ -33,7 +33,20 @@ Kinds:
                         ``arrays.npz`` (a torn write; exercises digest
                         verification + the restore cascade);
   * ``ckpt_corrupt``  — save_checkpoint flips one byte of the committed
-                        ``arrays.npz`` (a bit flip; same recovery path).
+                        ``arrays.npz`` (a bit flip; same recovery path);
+  * ``device_loss``   — fit() marks one device (the highest live ordinal)
+                        as PERMANENTLY lost at that training step; the
+                        elastic runtime (utils/elastic.py) must detect it
+                        at the next host-sync boundary and shrink onto
+                        the surviving mesh.  ``device_loss@5x2`` loses one
+                        device at step 5 and another at step 6 — one
+                        resize event covering both at the next boundary;
+  * ``host_crash``    — fit() raises :class:`~flexflow_tpu.utils.elastic.
+                        HostCrashError` at that training step, simulating
+                        this whole process dying mid-run (exercises the
+                        error-exit cleanup — coordinator release,
+                        prefetcher shutdown — and the ``--elastic``
+                        restart/rejoin protocol in distributed.py).
 
 One injector is installed process-globally (``install``/``get``) so data
 sources running on background threads see the same schedule; ``fit()``
@@ -47,7 +60,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-KINDS = ("loss_nan", "data_io", "ckpt_truncate", "ckpt_corrupt")
+KINDS = ("loss_nan", "data_io", "ckpt_truncate", "ckpt_corrupt",
+         "device_loss", "host_crash")
 
 
 class FaultSpecError(ValueError):

@@ -78,7 +78,17 @@ class StepHealthGuard:
             return None
         import jax
 
-        vals = [float(v) for v in jax.device_get(list(window))]
+        try:
+            vals = [float(v) for v in jax.device_get(list(window))]
+        except Exception as e:
+            # a dead device can make the window itself unreadable — say
+            # so in the obs stream, then let the error propagate so the
+            # elastic runtime (utils/elastic.py) can classify/probe it
+            self.olog.event("fault", source="guard",
+                            fault="window_unreadable",
+                            step=first_step + len(window) - 1,
+                            error=str(e))
+            raise
         bad = next((i for i, v in enumerate(vals)
                     if not math.isfinite(v)), None)
         if bad is None:

@@ -1,0 +1,428 @@
+"""Elastic training runtime (utils/elastic.py + the fit-loop wiring):
+transient-vs-permanent classification, injected device loss -> re-search
+-> regrid on a CPU mesh with loss continuity, checkpoint-restore
+fallback, async-writer determinism/crash-consistency, and max-shrink
+refusal."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.utils import elastic
+from flexflow_tpu.utils.retry import RetryPolicy
+
+BATCH = 24  # divisible by the 8-, 6- and 4-device meshes
+
+
+def _build(cfg, machine):
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((cfg.batch_size, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def _host_batches(seed=3, n=4, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    ring = [(rng.randn(batch, 16, 16, 3).astype("float32"),
+             rng.randint(0, 8, (batch,)).astype("int32"))
+            for _ in range(n)]
+    i = 0
+    while True:
+        yield ring[i % n]
+        i += 1
+
+
+def _cfg(**kw):
+    base = dict(batch_size=BATCH, input_height=16, input_width=16,
+                num_iterations=10, print_freq=2, num_classes=8, seed=3)
+    base.update(kw)
+    return FFConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# classification + probing
+
+
+def test_parse_new_fault_kinds():
+    from flexflow_tpu.utils.faultinject import parse_fault_spec
+
+    out = parse_fault_spec("device_loss@5x2,host_crash@3")
+    assert out == {"device_loss": [(5, 2)], "host_crash": [(3, 1)]}
+
+
+def test_fault_spec_flag_accepts_new_kinds():
+    cfg = FFConfig.from_args(["--fault-spec", "device_loss@3,host_crash@9"])
+    assert cfg.fault_spec == "device_loss@3,host_crash@9"
+
+
+def test_classify_patterns():
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert elastic.classify(XlaRuntimeError("DEVICE_UNAVAILABLE: chip 3"
+                                            .lower()))
+    assert elastic.classify(XlaRuntimeError("device unavailable"))
+    assert not elastic.classify(XlaRuntimeError("invalid argument"))
+    assert not elastic.classify(ValueError("device unavailable"))
+    assert elastic.classify(elastic.DeviceLostError("x"))
+
+
+def test_probe_transient_vs_permanent(machine8):
+    calls = {}
+
+    def probe(dev):
+        i = machine8.devices.index(dev)
+        calls[i] = calls.get(i, 0) + 1
+        if i == 3 and calls[i] < 2:
+            raise RuntimeError("hiccup")       # recovers on retry
+        if i == 7:
+            raise RuntimeError("dead forever")  # exhausts attempts
+
+    live, dead, transient = elastic.probe_devices(
+        machine8, policy=RetryPolicy(attempts=3, base_delay=0.0,
+                                     jitter=0.0),
+        probe=probe, sleep=lambda s: None)
+    assert dead == [7]
+    assert transient == [3]
+    assert live == [i for i in range(8) if i != 7]
+    assert calls[7] == 3  # bounded: attempts exhausted, not forever
+
+
+def test_shrink_machine(machine8):
+    m6 = machine8.shrink([0, 1, 2, 3, 4, 5])
+    assert m6.num_devices == 6
+    assert m6.devices == machine8.devices[:6]
+    assert m6.topology.devices_per_ici_group == 6
+    assert machine8.num_devices == 8  # never mutated
+    with pytest.raises(ValueError):
+        machine8.shrink([])
+    with pytest.raises(ValueError):
+        machine8.shrink([0, 99])
+
+
+def test_flag_plumbing_lm_nmt():
+    from flexflow_tpu.apps.lm import parse_args as lm_parse
+    from flexflow_tpu.apps.nmt import parse_args as nmt_parse
+
+    for parse in (lm_parse, nmt_parse):
+        cfg = parse(["--elastic", "--min-devices", "4",
+                     "--research-budget-s", "2.5", "--ckpt-async"])
+        assert cfg.elastic and cfg.min_devices == 4
+        assert cfg.research_budget_s == 2.5 and cfg.ckpt_async
+
+
+# ---------------------------------------------------------------------------
+# fit-loop integration (8-device simulated mesh)
+
+
+def test_elastic_byte_inert_on_healthy_runs(machine8):
+    def run(**kw):
+        ff = _build(_cfg(num_iterations=4, print_freq=0, **kw), machine8)
+        return ff.fit(_host_batches(), log=lambda *a: None,
+                      rebuild=_build)["loss"]
+
+    assert run() == run(elastic=True, min_devices=2)
+
+
+def test_injected_loss_recovers_in_memory(machine8, tmp_path):
+    cfg = _cfg(elastic=True, min_devices=2,
+               obs_dir=str(tmp_path / "obs"), run_id="el",
+               fault_spec="device_loss@3x2")
+    ff = _build(cfg, machine8)
+    out = ff.fit(_host_batches(), log=lambda *a: None, rebuild=_build)
+    # loss continuity: every iteration accounted for, all finite, no
+    # silent reset to a fresh init (the pre-resize history is kept)
+    assert len(out["loss"]) == 10
+    assert all(math.isfinite(l) for l in out["loss"])
+    assert out["elastic_resizes"] == 1
+    assert out["devices"] == 6
+    from flexflow_tpu import obs
+
+    events = list(obs.read_run(out["obs_path"]))
+    resizes = [e for e in events if e["kind"] == "elastic_resize"]
+    assert len(resizes) == 1
+    rz = resizes[0]
+    assert rz["from_devices"] == 8 and rz["to_devices"] == 6
+    assert rz["migration"] == "in_memory" and rz["steps_lost"] == 0
+    assert rz["regrid_hops"] > 0 and rz["regrid_bytes"] > 0
+    losses = [e for e in events if e["kind"] == "device_loss"]
+    assert losses and losses[0]["classification"] == "permanent"
+    assert sorted(losses[0]["dead"]) == [6, 7]
+
+
+def test_ckpt_fallback_when_migration_refused(machine8, tmp_path,
+                                              monkeypatch):
+    def refuse(*a, **k):
+        raise RuntimeError("in-memory migration refused (test)")
+
+    monkeypatch.setattr(elastic, "gather_state", refuse)
+    cfg = _cfg(elastic=True, min_devices=2,
+               ckpt_dir=str(tmp_path / "ckpt"), ckpt_freq=2,
+               obs_dir=str(tmp_path / "obs"), run_id="fb",
+               fault_spec="device_loss@3x2")
+    ff = _build(cfg, machine8)
+    out = ff.fit(_host_batches(), log=lambda *a: None, rebuild=_build)
+    assert len(out["loss"]) == 10
+    assert all(math.isfinite(l) for l in out["loss"])
+    from flexflow_tpu import obs
+
+    events = list(obs.read_run(out["obs_path"]))
+    assert any(e["kind"] == "elastic_fallback" for e in events)
+    rz = [e for e in events if e["kind"] == "elastic_resize"][0]
+    # detection at the step-4 boundary, newest checkpoint at step 2
+    assert rz["migration"] == "checkpoint"
+    assert rz["resume_step"] == 2 and rz["steps_lost"] == 2
+
+
+def test_min_devices_refusal(machine8):
+    cfg = _cfg(elastic=True, min_devices=8, fault_spec="device_loss@3")
+    ff = _build(cfg, machine8)
+    with pytest.raises(elastic.ElasticShrinkRefused):
+        ff.fit(_host_batches(), log=lambda *a: None, rebuild=_build)
+
+
+def test_device_loss_fatal_without_elastic(machine8):
+    cfg = _cfg(fault_spec="device_loss@3")  # elastic OFF
+    ff = _build(cfg, machine8)
+    with pytest.raises(elastic.DeviceLostError, match="--elastic"):
+        ff.fit(_host_batches(), log=lambda *a: None)
+
+
+def test_recovery_requires_rebuild_factory(machine8):
+    cfg = _cfg(elastic=True, min_devices=2, fault_spec="device_loss@3")
+    ff = _build(cfg, machine8)
+    with pytest.raises(elastic.DeviceLostError, match="rebuild"):
+        ff.fit(_host_batches(), log=lambda *a: None)  # no rebuild=
+
+
+def test_host_crash_raises_and_releases(machine8, monkeypatch):
+    from flexflow_tpu import distributed
+
+    released = []
+    monkeypatch.setattr(distributed, "release",
+                        lambda: released.append(True))
+    cfg = _cfg(fault_spec="host_crash@2")
+    ff = _build(cfg, machine8)
+    with pytest.raises(elastic.HostCrashError):
+        ff.fit(_host_batches(), log=lambda *a: None)
+    assert released  # error exit routed through coordinator cleanup
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing
+
+
+def _trees(seed=0):
+    rng = np.random.RandomState(seed)
+    params = {"fc": {"kernel": rng.randn(8, 8).astype("float32"),
+                     "bias": rng.randn(8).astype("float32")}}
+    state = {"bn": {"mean": rng.randn(4).astype("float32")}}
+    opt = {"fc": {"kernel": np.zeros((8, 8), "float32"),
+                  "bias": np.zeros((8,), "float32")}}
+    return params, state, opt
+
+
+def test_async_writer_bit_identical_to_sync(tmp_path):
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    params, state, opt = _trees()
+    sync_dir = str(tmp_path / "sync")
+    async_dir = str(tmp_path / "async")
+    ckpt.save_checkpoint(sync_dir, 5, params, state, opt)
+    w = ckpt.AsyncCheckpointWriter()
+    try:
+        w.submit(async_dir, 5, params, state, opt)
+        assert w.wait(timeout=10.0)
+    finally:
+        w.close()
+    assert w.saves == 1 and w.inflight == 0
+    ok, why = ckpt.verify_checkpoint(async_dir, 5)
+    assert ok, why
+    with np.load(os.path.join(sync_dir, "step_00000005",
+                              "arrays.npz")) as za, \
+            np.load(os.path.join(async_dir, "step_00000005",
+                                 "arrays.npz")) as zb:
+        assert sorted(za.files) == sorted(zb.files)
+        for k in za.files:
+            a, b = za[k], zb[k]
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert a.tobytes() == b.tobytes(), k
+
+
+def test_async_writer_snapshot_isolates_mutation(tmp_path):
+    """The submit-time snapshot means later in-place mutation of the live
+    trees (the next step donating buffers) cannot leak into the commit."""
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    params, state, opt = _trees()
+    expect = params["fc"]["kernel"].copy()
+    w = ckpt.AsyncCheckpointWriter()
+    try:
+        w.submit(str(tmp_path), 1, params, state, opt)
+        params["fc"]["kernel"][:] = -1.0  # mutate AFTER submit
+        assert w.wait(timeout=10.0)
+    finally:
+        w.close()
+    _, p, _, _ = ckpt.restore_checkpoint(str(tmp_path))
+    assert np.array_equal(p["fc"]["kernel"], expect)
+
+
+def test_async_crash_before_commit_leaves_only_swept_tmp(tmp_path):
+    """A write killed before the atomic rename leaves only a tmp.<step>
+    staging dir; the next save/restore sweeps it and never trusts it."""
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    params, state, opt = _trees()
+    d = str(tmp_path)
+    # simulate the torn write: staging dir exists, no committed step
+    os.makedirs(os.path.join(d, "tmp.3"))
+    with open(os.path.join(d, "tmp.3", "arrays.npz"), "wb") as f:
+        f.write(b"torn")
+    assert ckpt.latest_step(d) is None  # never visible as a checkpoint
+    ckpt.save_checkpoint(d, 4, params, state, opt)
+    assert not os.path.exists(os.path.join(d, "tmp.3"))  # swept
+    assert ckpt.latest_step(d) == 4
+
+
+def test_async_writer_nonfinite_counts_fault(tmp_path):
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    params, state, opt = _trees()
+    params["fc"]["kernel"][0, 0] = float("nan")
+    w = ckpt.AsyncCheckpointWriter()
+    try:
+        w.submit(str(tmp_path), 2, params, state, opt)
+        assert w.wait(timeout=10.0)
+    finally:
+        w.close()
+    assert w.faults == 1 and w.saves == 0
+    assert ckpt.latest_step(str(tmp_path)) is None
+
+
+def test_fit_ckpt_async_matches_sync_bytes(machine8, tmp_path):
+    """End-to-end: the async run's committed checkpoints verify clean and
+    carry the exact same array payloads as a sync run of the same
+    config."""
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    def run(d, **kw):
+        cfg = _cfg(num_iterations=4, print_freq=0, ckpt_dir=d,
+                   ckpt_freq=2, **kw)
+        ff = _build(cfg, machine8)
+        return ff.fit(_host_batches(), log=lambda *a: None)
+
+    a = run(str(tmp_path / "sync"))
+    b = run(str(tmp_path / "async"), ckpt_async=True)
+    assert a["loss"] == b["loss"]
+    assert b["ckpt_async_saves"] == 2  # step 2 + final
+    for step in (2, 4):
+        for d in (str(tmp_path / "sync"), str(tmp_path / "async")):
+            ok, why = ckpt.verify_checkpoint(d, step)
+            assert ok, (d, step, why)
+        with np.load(os.path.join(str(tmp_path / "sync"),
+                                  f"step_{step:08d}",
+                                  "arrays.npz")) as za, \
+                np.load(os.path.join(str(tmp_path / "async"),
+                                     f"step_{step:08d}",
+                                     "arrays.npz")) as zb:
+            assert sorted(za.files) == sorted(zb.files)
+            for k in za.files:
+                assert za[k].tobytes() == zb[k].tobytes(), (step, k)
+
+
+# ---------------------------------------------------------------------------
+# migration accounting + report rendering
+
+
+def test_plan_state_migration_accounting(machine8):
+    from flexflow_tpu.parallel.regrid import plan_state_migration
+
+    old = _build(_cfg(), machine8)
+    new = _build(_cfg(), machine8.shrink(range(6)))
+    params, _ = old.init()
+    full = {op.param_key: {k: np.asarray(v) for k, v in
+                           old._member_params(params, op).items()}
+            for op in old.layers if op.param_key in params}
+    plan = plan_state_migration(old, new, full)
+    leaf_bytes = sum(np.asarray(v).nbytes for sub in full.values()
+                     for v in sub.values())
+    assert plan["from_devices"] == 8 and plan["to_devices"] == 6
+    assert plan["bytes"] == pytest.approx(leaf_bytes)
+    assert plan["hops"] >= plan["keys"] > 0
+    assert plan["predicted_s"] > 0
+
+
+def test_report_renders_elastic_records(machine8, tmp_path):
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs.report import render, summarize
+
+    cfg = _cfg(elastic=True, min_devices=2, ckpt_async=True,
+               ckpt_dir=str(tmp_path / "ckpt"), ckpt_freq=2,
+               obs_dir=str(tmp_path / "obs"), run_id="rr",
+               fault_spec="device_loss@3x2")
+    ff = _build(cfg, machine8)
+    out = ff.fit(_host_batches(), log=lambda *a: None, rebuild=_build)
+    events = list(obs.read_run(out["obs_path"]))
+    text = render(events)
+    assert "== elastic ==" in text
+    assert "elastic_resize: 8 -> 6" in text
+    assert "async checkpoints:" in text
+    s = summarize(events)
+    assert s["elastic"]["counts"]["elastic_resize"] == 1
+    assert s["elastic"]["resizes"][0]["to_devices"] == 6
+    assert s["elastic"]["ckpt_async"]["commits"] >= 1
+
+
+def test_metrics_export_elastic_gauges(machine8, tmp_path):
+    from flexflow_tpu.obs.metrics import read_textfile
+
+    cfg = _cfg(elastic=True, min_devices=2, ckpt_async=True,
+               ckpt_dir=str(tmp_path / "ckpt"), ckpt_freq=2,
+               metrics_path=str(tmp_path / "metrics.prom"),
+               fault_spec="device_loss@3x2")
+    ff = _build(cfg, machine8)
+    ff.fit(_host_batches(), log=lambda *a: None, rebuild=_build)
+    gauges = read_textfile(str(tmp_path / "metrics.prom"))
+    assert gauges["elastic_events"] == 1.0
+    assert gauges["ckpt_async_inflight"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# surviving-mesh re-search (native simulator)
+
+
+@pytest.mark.native
+def test_warm_start_and_budget(machine8):
+    from flexflow_tpu.sim.search import StrategySearch
+
+    m6 = machine8.shrink(range(6))
+    old = _build(_cfg(), machine8)
+    new = _build(_cfg(), m6)
+    # an 8-device strategy: every entry names devices the 6-device mesh
+    # cannot host, so the warm start must invalidate them all to DP
+    ss8 = StrategySearch(old, machine=machine8)
+    strat8, _ = ss8.search(iters=0)
+    ss6 = StrategySearch(new, machine=m6)
+    warm = elastic.warm_assignment(ss6, strat8)
+    assert warm == ss6.dp_assignment()
+    # a 6-device strategy survives the warm start verbatim
+    strat6, _ = ss6.search(iters=0)
+    warm2 = elastic.warm_assignment(ss6, strat6)
+    assert warm2 == ss6.assignment_for(strat6)
+    # wall-clock budget: stops after the first chunk, still returns a
+    # valid strategy
+    strat, info = ss6.search(iters=4000, chunks=8, budget_s=0.0,
+                             start=warm)
+    assert info["budget_hit"] is True
+    assert 0 < info["iters_done"] < 4000
+    assert len(strat) == len(new.layers)
