@@ -2,14 +2,17 @@
 PYTHON ?= python
 
 .PHONY: native check trace-smoke test bench-smoke fault-smoke budget-smoke \
-	elastic-smoke
+	elastic-smoke preempt-smoke rejoin-smoke
 
 # build the native simulator + dataloader libraries
 native:
 	$(MAKE) -C flexflow_tpu/native
 
-# native build + ctypes smoke of ffsim_simulate
+# native build + ctypes smoke of ffsim_simulate, plus repo consistency:
+# every injectable fault kind must be documented in README.md's fault
+# table and covered by at least one test (tools/check_fault_kinds.py)
 check:
+	$(PYTHON) tools/check_fault_kinds.py
 	$(MAKE) -C flexflow_tpu/native check
 
 # build libffsim.so and assert ffsim_simulate_trace produces a parseable
@@ -41,16 +44,37 @@ bench-smoke:
 fault-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m flexflow_tpu.apps.fault_smoke
 
-# elastic-runtime smoke (elastic round): equivalence phase (elastic-
-# enabled no-fault run bit-identical to baseline) + recovery phase (an
-# injected permanent device loss shrinks the 8-device simulated mesh to
-# 6 mid-run: surviving-mesh re-search + live-state regrid, exactly one
-# elastic_resize record, finite losses to completion, and a verified
-# async-committed final checkpoint)
+# elastic-runtime smoke (elastic round + re-expansion round):
+# equivalence phase (elastic + watchdog + drain handler enabled, no
+# faults: bit-identical to baseline) + lifecycle phase (injected
+# device loss shrinks the 8-device simulated mesh to 6 mid-run, then
+# the injected device_return grows it back 6 -> 8 after the probe
+# streak: exactly two elastic_resize records — one per direction —
+# finite losses to completion, and a verified async-committed final
+# checkpoint)
 elastic-smoke:
 	env JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m flexflow_tpu.apps.elastic_smoke
+
+# preemption-drain smoke (re-expansion round): a subprocess run with
+# preempt@5 injected must finish the in-flight step, commit a verified
+# checkpoint through the async writer inside --drain-budget-s, emit one
+# preempt_drain record, and EXIT 0 (the scheduler contract); a fresh
+# resume from the drained checkpoint must be bit-equal to the
+# uninterrupted baseline's tail
+preempt-smoke:
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.preempt_smoke
+
+# real 2-process elastic_rejoin smoke (env-gated: skips with the reason
+# unless FF_REJOIN_SMOKE=1 — spawning real coordinator services is slow
+# and port-sensitive): two fresh worker processes reconnect to the
+# coordinator, form the 8-device world, and restore a verified
+# checkpoint onto the rejoined mesh
+rejoin-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m flexflow_tpu.apps.rejoin_smoke
 
 # MFU-waterfall smoke (observability): tiny CNN with sampled op timing +
 # live metrics export; asserts the step_budget bucket invariant, a

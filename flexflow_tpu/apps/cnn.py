@@ -112,6 +112,12 @@ def main(argv=None, log=print) -> dict:
         out = ff.fit(data, log=log, rebuild=builders[model_name])
     finally:
         data_olog.close()
+    if out.get("drained"):
+        # graceful preemption drain: the run stopped cleanly with a
+        # verified checkpoint; exit 0 is the scheduler contract (a
+        # non-zero exit here would be retried as a FAILURE)
+        log(f"drained at iteration {out.get('completed_steps')}; "
+            f"exiting 0 (resume from --ckpt-dir to continue)")
     out.pop("params", None)
     out.pop("state", None)
     return out
@@ -119,3 +125,4 @@ def main(argv=None, log=print) -> dict:
 
 if __name__ == "__main__":
     main()
+    sys.exit(0)

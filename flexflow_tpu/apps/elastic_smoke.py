@@ -1,20 +1,25 @@
 """Elastic-runtime smoke — the ``make elastic-smoke`` entry point
-(elastic round).
+(elastic round; extended with re-expansion in the re-expansion/drain/
+watchdog round).
 
 Two phases, mirroring ``fault_smoke``'s assertion style:
 
-  1. **equivalence** — with ``--elastic`` ENABLED but no faults injected,
-     the run must produce BIT-EQUAL losses to a baseline (elastic off)
-     run: the elastic machinery adds no per-step host syncs and never
-     perturbs a healthy run;
-  2. **recovery** — a tiny CNN trains on an 8-device simulated CPU mesh
-     with ``device_loss@3x2`` injected (ordinals 7 then 6 die at steps 3
-     and 4), under ``--elastic --ckpt-async``.  The run must COMPLETE
-     all iterations with finite losses after shrinking onto the
-     6-device surviving mesh, the obs stream must carry exactly ONE
-     ``elastic_resize`` record (re-search + live regrid, zero steps
-     lost), and the final checkpoint — committed by the async writer —
-     must verify clean.
+  1. **equivalence** — with ``--elastic``, the step watchdog
+     (``--hang-factor``), and the drain signal handler all ENABLED but
+     no faults injected, the run must produce BIT-EQUAL losses to a
+     baseline (everything off) run: the elastic/health/drain machinery
+     adds no per-step host syncs and never perturbs a healthy run;
+  2. **lifecycle** — a tiny CNN trains on an 8-device simulated CPU
+     mesh with ``device_loss@3x2,device_return@2`` injected (ordinals
+     7 then 6 die at steps 3 and 4; the injected devices start
+     answering regrow probes from the second boundary probe), under
+     ``--elastic --ckpt-async``.  The run must shrink 8->6 at the
+     step-4 boundary, probe the dead ordinals at subsequent
+     boundaries, GROW back 6->8 once the probe streak reaches
+     ``--regrow-probes``, COMPLETE all iterations with finite losses,
+     carry exactly TWO ``elastic_resize`` records (one per direction,
+     shrink before grow), and the final checkpoint — committed by the
+     async writer — must verify clean.
 
 Everything runs on CPU in seconds; assertion failures exit non-zero.
 
@@ -34,7 +39,7 @@ os.environ.setdefault("XLA_FLAGS",
 
 import numpy as np
 
-FAULT_SPEC = "device_loss@3x2"
+FAULT_SPEC = "device_loss@3x2,device_return@2"
 ITERS = 12
 BATCH = 24  # divisible by both the 8-device and the 6-device mesh
 
@@ -54,7 +59,7 @@ def _build(cfg, machine):
 def _host_batches(seed: int = 3, n: int = 4):
     """HOST numpy batches (the prefetcher places them with the CURRENT
     machine's sharding) — after a resize the continuation re-places onto
-    the surviving mesh instead of feeding stale 8-device arrays."""
+    the resized mesh instead of feeding stale 8-device arrays."""
     rng = np.random.RandomState(seed)
     ring = [(rng.randn(BATCH, 16, 16, 3).astype("float32"),
              rng.randint(0, 8, (BATCH,)).astype("int32"))
@@ -76,19 +81,21 @@ def _cfg(**kw):
 
 
 def _check_equivalence(machine, log) -> None:
-    """Elastic-enabled-but-healthy == baseline: losses bit-equal, zero
-    behavior drift from the elastic machinery itself."""
+    """Elastic + watchdog + drain-handler enabled-but-healthy ==
+    baseline: losses bit-equal, zero behavior drift from the round-9
+    machinery itself."""
     def run(**kw):
         ff = _build(_cfg(num_iterations=4, print_freq=0, **kw), machine)
         return ff.fit(_host_batches(), log=lambda *a: None,
                       rebuild=_build)["loss"]
 
-    a = run()                                    # baseline (elastic off)
-    b = run(elastic=True, min_devices=2)         # elastic, no faults
+    a = run()                                    # baseline (all off)
+    b = run(elastic=True, min_devices=2,         # elastic + watchdog on
+            hang_factor=50.0, hang_min_s=120.0)
     assert a == b, \
-        f"elastic must be byte-inert on healthy runs: {a} vs {b}"
+        f"elastic+watchdog must be byte-inert on healthy runs: {a} vs {b}"
     log(f"equivalence ok: {len(a)} losses bit-equal with and without "
-        f"--elastic")
+        f"--elastic --hang-factor")
 
 
 def main(argv=None, log=print) -> int:
@@ -113,6 +120,7 @@ def main(argv=None, log=print) -> int:
                    obs_dir=os.path.join(td, "obs"),
                    run_id="elastic-smoke", elastic=True, min_devices=2,
                    ckpt_async=True, research_budget_s=10.0,
+                   max_regrows=1, regrow_probes=2,
                    fault_spec=FAULT_SPEC)
         ff = _build(cfg, machine)
         out = ff.fit(_host_batches(), log=log, rebuild=_build)
@@ -122,11 +130,11 @@ def main(argv=None, log=print) -> int:
             f"{len(out['loss'])}"
         assert all(math.isfinite(l) for l in out["loss"]), \
             f"post-resize loss history must be finite: {out['loss']}"
-        assert out["elastic_resizes"] == 1, \
-            f"expected exactly one resize, got {out['elastic_resizes']}"
-        assert out["devices"] == 6, \
-            f"run must end on the 6-device surviving mesh, got " \
-            f"{out['devices']}"
+        assert out["elastic_resizes"] == 2, \
+            f"expected a shrink AND a grow, got {out['elastic_resizes']}"
+        assert out["devices"] == 8, \
+            f"run must END on the full 8-device mesh after the grow, " \
+            f"got {out['devices']}"
         last = ckpt.latest_step(cfg.ckpt_dir)
         ok, why = ckpt.verify_checkpoint(cfg.ckpt_dir, last)
         assert last == ITERS and ok, \
@@ -136,35 +144,52 @@ def main(argv=None, log=print) -> int:
         events = list(obs.read_run(out["obs_path"]))
         kinds = [e["kind"] for e in events]
         resizes = [e for e in events if e["kind"] == "elastic_resize"]
-        assert len(resizes) == 1, \
-            f"expected exactly one elastic_resize record, got " \
-            f"{len(resizes)} in {sorted(set(kinds))}"
-        rz = resizes[0]
-        assert rz["from_devices"] == 8 and rz["to_devices"] == 6, rz
-        assert rz["migration"] in ("in_memory", "checkpoint"), rz
+        assert len(resizes) == 2, \
+            f"expected exactly two elastic_resize records (shrink + " \
+            f"grow), got {len(resizes)} in {sorted(set(kinds))}"
+        shrink, grow = resizes
+        assert shrink.get("direction") == "shrink" \
+            and shrink["from_devices"] == 8 \
+            and shrink["to_devices"] == 6, shrink
+        assert grow.get("direction") == "grow" \
+            and grow["from_devices"] == 6 \
+            and grow["to_devices"] == 8, grow
+        assert shrink["migration"] in ("in_memory", "checkpoint"), shrink
+        assert grow["migration"] == "in_memory", grow
         i_inj = next(i for i, e in enumerate(events)
                      if e["kind"] == "fault"
                      and e.get("fault") == "device_loss")
         i_det = next(i for i, e in enumerate(events)
                      if e["kind"] == "device_loss")
-        i_rz = events.index(rz)
-        assert i_inj < i_det < i_rz, \
+        i_ret = next(i for i, e in enumerate(events)
+                     if e["kind"] == "device_return")
+        i_shrink = events.index(shrink)
+        i_grow = events.index(grow)
+        assert i_inj < i_det < i_shrink < i_ret < i_grow, \
             "records must read injected fault -> device_loss -> " \
-            "elastic_resize in order"
+            "resize(shrink) -> device_return -> resize(grow) in order"
+        probes = [e for e in events if e["kind"] == "device_probe"
+                  and e.get("needed") is not None]
+        assert probes, \
+            f"boundary regrow probes must be recorded: " \
+            f"{sorted(set(kinds))}"
         assert "ckpt_async" in kinds, \
             f"async writer must emit ckpt_async records: " \
             f"{sorted(set(kinds))}"
 
         summary = summarize(events)
         assert "elastic" in summary \
-            and summary["elastic"]["counts"].get("elastic_resize") == 1, \
+            and summary["elastic"]["counts"].get("elastic_resize") == 2, \
             summary.get("elastic")
+        dirs = [r["direction"] for r in summary["elastic"]["resizes"]]
+        assert dirs == ["shrink", "grow"], dirs
 
         log(f"elastic-smoke ok: {ITERS} iters survived {FAULT_SPEC!r} "
-            f"with one 8->6 resize (re-search "
-            f"{rz['research_s'] * 1e3:.0f} ms "
-            f"[{(rz.get('research') or {}).get('mode')}], migration "
-            f"{rz['migration']}, {rz['steps_lost']} steps lost), final "
+            f"with an 8->6 shrink at step {shrink['step']} and a 6->8 "
+            f"grow at step {grow['step']} (after "
+            f"{len(probes)} boundary probe(s); grow re-search "
+            f"{grow['research_s'] * 1e3:.0f} ms "
+            f"[{(grow.get('research') or {}).get('mode')}]), final "
             f"loss {out['loss'][-1]:.4f}, verified async checkpoint at "
             f"step {last}")
     return 0

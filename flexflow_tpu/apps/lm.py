@@ -105,6 +105,18 @@ def parse_args(argv) -> TransformerConfig:
             cfg.min_devices = int(val())
         elif a == "--research-budget-s":
             cfg.research_budget_s = float(val())
+        elif a == "--max-regrows":
+            cfg.max_regrows = int(val())
+        elif a == "--regrow-probes":
+            cfg.regrow_probes = int(val())
+        elif a == "--drain-budget-s":
+            cfg.drain_budget_s = float(val())
+        elif a == "--hang-factor":
+            cfg.hang_factor = float(val())
+        elif a == "--hang-min-s":
+            cfg.hang_min_s = float(val())
+        elif a == "--transient-reset-steps":
+            cfg.transient_reset_steps = int(val())
         elif a == "--ckpt-async":
             cfg.ckpt_async = True
         # unknown flags ignored, like the reference parser
@@ -255,7 +267,15 @@ def main(argv=None, log=print) -> dict:
         f"{cfg.batch_size}{moe}, {machine.num_devices} devices")
     data = synthetic_lm_batches(machine, cfg.batch_size, cfg.seq_length,
                                 cfg.vocab_size, seed=cfg.seed)
-    out = model.fit(data, log=log)
+    # the elastic rebuild factory: reconstruct the LM on a resized mesh
+    # under the re-searched strategy (ff_cfg carries the strategies)
+    out = model.fit(
+        data, log=log,
+        rebuild=lambda ff_cfg, m: TransformerLM(cfg, m,
+                                                ff_cfg.strategies))
+    if out.get("drained"):
+        log(f"drained at iteration {out.get('completed_steps')}; "
+            f"exiting 0 (resume from --ckpt-dir to continue)")
     out["tokens_per_sec"] = (out.get("images_per_sec") or 0.0) \
         * cfg.seq_length
     if out["tokens_per_sec"]:

@@ -96,6 +96,18 @@ def parse_args(argv) -> RnnConfig:
             cfg.min_devices = int(val())
         elif a == "--research-budget-s":
             cfg.research_budget_s = float(val())
+        elif a == "--max-regrows":
+            cfg.max_regrows = int(val())
+        elif a == "--regrow-probes":
+            cfg.regrow_probes = int(val())
+        elif a == "--drain-budget-s":
+            cfg.drain_budget_s = float(val())
+        elif a == "--hang-factor":
+            cfg.hang_factor = float(val())
+        elif a == "--hang-min-s":
+            cfg.hang_min_s = float(val())
+        elif a == "--transient-reset-steps":
+            cfg.transient_reset_steps = int(val())
         elif a == "--ckpt-async":
             cfg.ckpt_async = True
         # unknown flags ignored, like the reference parser
@@ -122,7 +134,14 @@ def main(argv=None, log=print) -> dict:
         f"batch {cfg.batch_size}, {machine.num_devices} devices")
     data = synthetic_token_batches(machine, cfg.batch_size, cfg.seq_length,
                                    cfg.vocab_size, seed=cfg.seed)
-    out = model.fit(data, log=log)
+    # the elastic rebuild factory: reconstruct the RNN on a resized mesh
+    # under the re-searched strategy (ff_cfg carries the strategies)
+    out = model.fit(
+        data, log=log,
+        rebuild=lambda ff_cfg, m: RnnModel(cfg, m, ff_cfg.strategies))
+    if out.get("drained"):
+        log(f"drained at iteration {out.get('completed_steps')}; "
+            f"exiting 0 (resume from --ckpt-dir to continue)")
     out.pop("params", None)
     out.pop("state", None)
     return out

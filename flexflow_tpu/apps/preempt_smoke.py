@@ -1,0 +1,188 @@
+"""Preemption-drain smoke — the ``make preempt-smoke`` entry point
+(re-expansion/drain/watchdog round).
+
+Three phases:
+
+  1. **baseline** — the tiny CNN trains 12 uninterrupted iterations
+     in-process (reference loss history for the continuity check);
+  2. **drain** — the SAME training runs in a SUBPROCESS with
+     ``preempt@5`` injected: the injector raises SIGTERM through the
+     installed drain handler mid-run, the worker finishes the in-flight
+     step, commits a verified checkpoint through the async writer
+     within ``--drain-budget-s``, emits ONE ``preempt_drain`` record,
+     and — the scheduler contract — **exits 0**;
+  3. **resume** — a fresh in-process run over the same ``--ckpt-dir``
+     restores from the drained checkpoint and finishes the remaining
+     iterations; with the data stream re-aligned its losses must be
+     BIT-EQUAL to the baseline's tail (drain + resume loses nothing).
+
+Everything runs on CPU in seconds; assertion failures exit non-zero.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python -m flexflow_tpu.apps.preempt_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+
+FAULT_SPEC = "preempt@5"
+ITERS = 12
+DRAIN_STEP = 6  # preempt fires at step 5; drain lands on the step-6 boundary
+BATCH = 16
+
+
+def _build(cfg, machine):
+    from flexflow_tpu.model import FFModel
+
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((cfg.batch_size, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def _host_batches(seed: int = 5, n: int = 4):
+    rng = np.random.RandomState(seed)
+    ring = [(rng.randn(BATCH, 16, 16, 3).astype("float32"),
+             rng.randint(0, 8, (BATCH,)).astype("int32"))
+            for _ in range(n)]
+    i = 0
+    while True:
+        yield ring[i % n]
+        i += 1
+
+
+def _cfg(**kw):
+    from flexflow_tpu.config import FFConfig
+
+    base = dict(batch_size=BATCH, input_height=16, input_width=16,
+                num_iterations=ITERS, print_freq=2, num_classes=8,
+                seed=5)
+    base.update(kw)
+    return FFConfig(**base)
+
+
+def _worker(td: str) -> int:
+    """The preempted training process: runs under ``preempt@5``, drains,
+    and exits 0 — the parent asserts the literal returncode."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu.machine import MachineModel
+
+    cfg = _cfg(ckpt_dir=os.path.join(td, "ckpt"), ckpt_freq=2,
+               obs_dir=os.path.join(td, "obs"), run_id="preempt-smoke",
+               ckpt_async=True, drain_budget_s=30.0,
+               fault_spec=FAULT_SPEC)
+    ff = _build(cfg, MachineModel())
+    out = ff.fit(_host_batches(), log=print)
+    with open(os.path.join(td, "worker.json"), "w") as f:
+        json.dump({"drained": bool(out.get("drained")),
+                   "completed_steps": out.get("completed_steps"),
+                   "loss": [float(l) for l in out["loss"]],
+                   "drain": out.get("drain"),
+                   "obs_path": out.get("obs_path")}, f)
+    # the scheduler contract: a graceful drain is SUCCESS, not failure
+    return 0 if out.get("drained") else 3
+
+
+def main(argv=None, log=print) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["--worker"]:
+        return _worker(argv[1])
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu import obs
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    if jax.device_count() != 8:
+        log(f"preempt-smoke needs the 8-device simulated mesh "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=8), "
+            f"got {jax.device_count()} devices")
+        return 2
+    machine = MachineModel()
+
+    # phase 1: uninterrupted baseline (continuity reference)
+    base = _build(_cfg(print_freq=0), machine).fit(
+        _host_batches(), log=lambda *a: None)["loss"]
+    assert len(base) == ITERS
+
+    with tempfile.TemporaryDirectory(prefix="ff-preempt-smoke-") as td:
+        # phase 2: the preempted subprocess must drain and exit 0
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        proc = subprocess.run(
+            [sys.executable, "-m", "flexflow_tpu.apps.preempt_smoke",
+             "--worker", td],
+            env=env, capture_output=True, text=True, timeout=600)
+        sys.stdout.write(proc.stdout)
+        assert proc.returncode == 0, \
+            f"drained worker must exit 0 (the scheduler contract), " \
+            f"got {proc.returncode}:\n{proc.stderr[-2000:]}"
+        with open(os.path.join(td, "worker.json")) as f:
+            w = json.load(f)
+        assert w["drained"] and w["completed_steps"] == DRAIN_STEP, w
+        assert len(w["loss"]) == DRAIN_STEP, w["loss"]
+
+        ckpt_dir = os.path.join(td, "ckpt")
+        last = ckpt.latest_step(ckpt_dir)
+        ok, why = ckpt.verify_checkpoint(ckpt_dir, last)
+        assert last == DRAIN_STEP and ok, \
+            f"drain checkpoint must verify clean at step {DRAIN_STEP}: " \
+            f"step {last}, {why}"
+
+        events = list(obs.read_run(w["obs_path"]))
+        drains = [e for e in events if e["kind"] == "preempt_drain"]
+        assert len(drains) == 1, \
+            f"expected exactly one preempt_drain record, got " \
+            f"{len(drains)}"
+        d = drains[0]
+        assert d["step"] == DRAIN_STEP \
+            and d["ckpt_step"] == DRAIN_STEP, d
+        assert d["mode"] in ("async", "boundary_save", "sync",
+                             "sync_fallback"), d
+        assert d["seconds"] <= d["budget_s"], \
+            f"drain must land inside the budget: {d}"
+
+        # phase 3: fresh process resumes from the drained checkpoint
+        ff = _build(_cfg(ckpt_dir=ckpt_dir, ckpt_freq=2), machine)
+        out = ff.fit(_host_batches(), log=log)
+        resumed = [float(l) for l in out["loss"]]
+        assert len(resumed) == ITERS - DRAIN_STEP, \
+            f"resume must run the remaining {ITERS - DRAIN_STEP} " \
+            f"iterations, got {len(resumed)}"
+        assert all(math.isfinite(l) for l in resumed), resumed
+        tail = [float(l) for l in base[DRAIN_STEP:]]
+        assert resumed == tail, \
+            f"drain + resume must lose nothing: resumed {resumed} vs " \
+            f"baseline tail {tail}"
+        assert w["loss"] == [float(l) for l in base[:DRAIN_STEP]], \
+            "pre-drain losses must match the baseline head"
+
+        log(f"preempt-smoke ok: {FAULT_SPEC!r} drained at step "
+            f"{DRAIN_STEP} in {d['seconds']:.2f}s of the "
+            f"{d['budget_s']:.0f}s budget (mode {d['mode']}, exit 0), "
+            f"verified checkpoint at step {last}, resume bit-equal to "
+            f"the uninterrupted baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""Real 2-process ``elastic_rejoin`` smoke — the ``make rejoin-smoke``
+entry point (re-expansion/drain/watchdog round).
+
+The unit suite covers the rejoin protocol single-process; this smoke
+exercises it for REAL.  The parent seeds a verified checkpoint (the
+cluster state at the moment of preemption), then spawns two FRESH OS
+processes — respawned hosts are always fresh processes: jax forbids
+re-initializing ``jax.distributed`` once the backend is live — each
+owning 4 virtual CPU devices.  Each worker's FIRST jax action is
+``distributed.elastic_rejoin``: connect to the coordinator (process 0
+binds the service; retries absorb the startup window), form the
+8-device world over the Gloo/gRPC backend, build the tiny CNN on the
+rejoined mesh through the model FACTORY, and restore the verified
+checkpoint onto its shardings.  Both workers then take one jitted
+training step, must exit 0, report the restored step and the 8-device
+world, and observe the SAME post-restore loss.
+
+Spawning real coordinator services is slow and port-sensitive, so the
+smoke is ENV-GATED: it skips (exit 0, with the reason) unless
+``FF_REJOIN_SMOKE=1``.
+
+    FF_REJOIN_SMOKE=1 JAX_PLATFORMS=cpu \\
+        python -m flexflow_tpu.apps.rejoin_smoke
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+ITERS = 3  # parent pre-seed steps before the simulated preemption
+
+WORKER = textwrap.dedent('''
+import os, sys
+pid, port, ckpt_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from flexflow_tpu import distributed
+from flexflow_tpu.apps.rejoin_smoke import build_tiny, make_batch
+
+# the respawned host's FIRST jax action is the rejoin: connect, form
+# the 8-device world, build the model on the rejoined mesh (factory),
+# restore the verified checkpoint onto its shardings
+built = {}
+
+def factory(machine):
+    built["ff"] = build_tiny(machine)
+    return built["ff"]
+
+machine, step, params, state, opt_state = distributed.elastic_rejoin(
+    ckpt_dir, coordinator_address="localhost:" + port,
+    num_processes=2, process_id=pid, model=factory,
+    coordinator_timeout_s=60.0, connect_attempts=5)
+assert jax.process_count() == 2, jax.process_count()
+assert machine.num_devices == 8, machine.num_devices
+ff = built["ff"]
+
+# every restored leaf must be a GLOBAL array on the rejoined mesh whose
+# local shards bit-match the checkpoint bytes (pure local check, no
+# collectives — it must hold on any backend)
+import numpy as np
+from flexflow_tpu.utils import checkpoint as ckptmod
+_, host_params, _, _ = ckptmod.restore_checkpoint(ckpt_dir)
+checked = 0
+for key, sub in host_params.items():
+    for k, v in sub.items():
+        g = params[key][k]
+        for shard in g.addressable_shards:
+            np.testing.assert_array_equal(np.asarray(shard.data),
+                                          np.asarray(v)[shard.index])
+            checked += 1
+assert checked > 0
+
+# resume: one jitted training step on the rejoined mesh.  Some jaxlib
+# CPU builds cannot EXECUTE cross-process collectives (tracked by the
+# pre-existing tests/test_distributed xfail on such rigs); the rejoin
+# protocol itself — reconnect, world formation, verified restore —
+# already succeeded above, so report the limitation instead of failing.
+try:
+    train = ff.make_train_step()
+    img, lbl = make_batch(machine)
+    params, state, opt_state, loss = train(params, state, opt_state,
+                                           img, lbl)
+    print(f"REJOIN {step} {machine.num_devices} {float(loss):.6f}",
+          flush=True)
+except Exception as e:
+    if "Multiprocess computations" not in str(e):
+        raise
+    print(f"REJOIN {step} {machine.num_devices} backend-unsupported",
+          flush=True)
+released = distributed.release()
+assert released, "rejoined worker must release the coordinator"
+''')
+
+
+def build_tiny(machine):
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.model import FFModel
+
+    cfg = FFConfig(batch_size=16, input_height=16, input_width=16,
+                   num_iterations=ITERS, print_freq=0, num_classes=8,
+                   seed=7)
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((cfg.batch_size, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def make_batch(machine, seed: int = 7):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return (rng.randn(16, 16, 16, 3).astype("float32"),
+            rng.randint(0, 8, (16,)).astype("int32"))
+
+
+def main(argv=None, log=print) -> int:
+    if os.environ.get("FF_REJOIN_SMOKE") != "1":
+        log("rejoin-smoke SKIPPED: spawning real 2-process coordinator "
+            "services is slow and port-sensitive, so this smoke is "
+            "opt-in — set FF_REJOIN_SMOKE=1 to run it")
+        return 0
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    if jax.device_count() != 8:
+        log(f"rejoin-smoke needs the 8-device simulated mesh, got "
+            f"{jax.device_count()} devices")
+        return 2
+
+    with tempfile.TemporaryDirectory(prefix="ff-rejoin-smoke-") as td:
+        # pre-seed the cluster state the respawned hosts will restore:
+        # a verified checkpoint from a short single-controller run
+        ckpt_dir = os.path.join(td, "ckpt")
+        machine = MachineModel()
+        ff = build_tiny(machine)
+        params, state = ff.init()
+        opt = ff.init_opt_state(params)
+        train = ff.make_train_step()
+        for _ in range(ITERS):
+            img, lbl = make_batch(machine)
+            params, state, opt, loss = train(params, state, opt, img,
+                                             lbl)
+        ckpt.save_checkpoint(ckpt_dir, ITERS, params, state, opt,
+                             ff.config.strategies)
+        ok, why = ckpt.verify_checkpoint(ckpt_dir, ITERS)
+        assert ok, f"pre-seeded checkpoint must verify: {why}"
+        log(f"seeded verified checkpoint at step {ITERS} "
+            f"(loss {float(loss):.4f})")
+
+        # free-port probe (same TOCTOU caveat as tests/test_distributed)
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("localhost", 0))
+            port = str(s.getsockname()[1])
+
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(i), port, ckpt_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=500)
+                outs.append(out)
+        finally:
+            # one worker dying leaves its peer blocked in initialize();
+            # never orphan it (or the port)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, \
+                f"worker {i} failed:\n{out[-3000:]}"
+        lines = []
+        for out in outs:
+            got = [l for l in out.splitlines() if l.startswith("REJOIN")]
+            assert got, f"worker printed no REJOIN line:\n{out[-2000:]}"
+            lines.append(got[0].split())
+        steps = [int(l[1]) for l in lines]
+        devs = [int(l[2]) for l in lines]
+        losses = [l[3] for l in lines]
+        assert steps == [ITERS, ITERS], \
+            f"both workers must restore step {ITERS}: {steps}"
+        assert devs == [8, 8], \
+            f"both workers must rejoin the 8-device world: {devs}"
+        if "backend-unsupported" in losses:
+            post = ("post-restore training step skipped: this jaxlib "
+                    "cannot execute cross-process collectives on CPU")
+        else:
+            assert float(losses[0]) == float(losses[1]), \
+                f"both workers must observe the same post-restore " \
+                f"loss: {losses}"
+            post = f"agreed on the post-restore loss {losses[0]}"
+
+        log(f"rejoin-smoke ok: 2 respawned processes reconnected to "
+            f"the coordinator, restored verified checkpoint step "
+            f"{steps[0]} onto the rejoined 8-device mesh "
+            f"(local shards bit-match the checkpoint); {post}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,6 +129,27 @@ class FFConfig:
     min_devices: int = 1
     research_budget_s: float = 30.0
     elastic_search_iters: int = 2000
+    # elastic re-expansion (round 9): after a shrink, previously-dead
+    # ordinals are probed at existing boundaries; --regrow-probes
+    # consecutive healthy probes trigger recover_grow (debounce), and a
+    # run grows back at most --max-regrows times (flapping cap; 0
+    # disables re-expansion entirely)
+    max_regrows: int = 1
+    regrow_probes: int = 2
+    # preemption-aware graceful drain: wall budget for committing the
+    # final verified checkpoint after SIGTERM/SIGINT (async writer wait,
+    # best-effort sync save fallback past the budget)
+    drain_budget_s: float = 60.0
+    # step watchdog (utils/health.StepWatchdog): hang deadline =
+    # hang_factor x rolling per-step estimate, floored at hang_min_s;
+    # 0 = watchdog off (the default — no timer threads in healthy runs)
+    hang_factor: float = 0.0
+    hang_min_s: float = 60.0
+    # transient-retry budget window: probe_devices transient verdicts
+    # consume a budget of 3; this many CONSECUTIVE healthy steps refill
+    # it, so a long run absorbs spread-out hiccups while rapid flapping
+    # still exhausts the cap
+    transient_reset_steps: int = 16
     # async checkpointing (utils/checkpoint.AsyncCheckpointWriter):
     # serialization/digest/commit on a background writer, at most one
     # save in flight; fit blocks only on the final save and before a
@@ -226,6 +247,18 @@ class FFConfig:
                 cfg.research_budget_s = float(val())
             elif a == "--elastic-search-iters":
                 cfg.elastic_search_iters = int(val())
+            elif a == "--max-regrows":
+                cfg.max_regrows = int(val())
+            elif a == "--regrow-probes":
+                cfg.regrow_probes = int(val())
+            elif a == "--drain-budget-s":
+                cfg.drain_budget_s = float(val())
+            elif a == "--hang-factor":
+                cfg.hang_factor = float(val())
+            elif a == "--hang-min-s":
+                cfg.hang_min_s = float(val())
+            elif a == "--transient-reset-steps":
+                cfg.transient_reset_steps = int(val())
             elif a == "--ckpt-async":
                 cfg.ckpt_async = True
             elif a == "--ckpt-dir":

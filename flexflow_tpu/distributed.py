@@ -27,7 +27,14 @@ from flexflow_tpu.machine import MachineModel, Topology
 
 # did THIS process bring up a jax.distributed client?  release()/rejoin
 # consult it so single-process runs never touch the coordinator.
+# _RELEASE_LOCK makes release() idempotent AND re-entrant: fit()'s drain
+# path and its error path can both reach it (possibly from a signal
+# handler interrupting the other caller), and exactly one of them may
+# run the actual shutdown.
+import threading as _threading
+
 _STATE = {"initialized": False}
+_RELEASE_LOCK = _threading.RLock()
 
 
 def is_initialized() -> bool:
@@ -148,21 +155,33 @@ def shutdown() -> None:
     """Tear down the jax.distributed client (idempotent)."""
     import jax
 
-    _STATE["initialized"] = False
+    with _RELEASE_LOCK:
+        _STATE["initialized"] = False
     try:
         jax.distributed.shutdown()
     except Exception:
         pass
 
 
-def release() -> None:
-    """Error-path coordinator cleanup: tear down the client IF this
-    process brought one up, no-op otherwise.  ``fit()`` calls this on
-    every error exit so a crashed host releases the coordinator (and its
-    barrier slot) promptly instead of holding the other hosts until
-    their timeout — previously only a clean exit shut it down."""
-    if _STATE["initialized"]:
-        shutdown()
+def release() -> bool:
+    """Coordinator cleanup: tear down the client IF this process brought
+    one up, no-op otherwise.  ``fit()`` calls this on every error exit
+    AND at the end of a graceful drain, so a departing host releases the
+    coordinator (and its barrier slot) promptly instead of holding the
+    other hosts until their timeout.  Idempotent and re-entrant — both
+    paths may call it, in any order, and only the first performs the
+    shutdown.  Returns True when this call did the teardown."""
+    with _RELEASE_LOCK:
+        if not _STATE["initialized"]:
+            return False
+        _STATE["initialized"] = False
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    return True
 
 
 def elastic_rejoin(ckpt_dir: str,
@@ -192,9 +211,14 @@ def elastic_rejoin(ckpt_dir: str,
     rebuilds its model on the rejoined mesh and resumes.
 
     With ``model`` given, restored leaves land on the model's shardings
-    (same contract as ``restore_checkpoint``).  When no checkpoint
-    exists yet, returns step 0 with None trees (a restart before the
-    first save simply begins again)."""
+    (same contract as ``restore_checkpoint``).  ``model`` may also be a
+    FACTORY ``machine -> model``: a respawned process cannot build its
+    model before rejoining (the global machine does not exist until
+    ``initialize`` returns, and jax forbids re-initializing after the
+    backend is live), so the factory is called with the rejoined
+    machine and the restore places onto the freshly built model.  When
+    no checkpoint exists yet, returns step 0 with None trees (a restart
+    before the first save simply begins again)."""
     from flexflow_tpu.utils import checkpoint as ckpt
 
     shutdown()
@@ -203,6 +227,9 @@ def elastic_rejoin(ckpt_dir: str,
                          process_id=process_id, topology=topology,
                          coordinator_timeout_s=coordinator_timeout_s,
                          connect_attempts=connect_attempts)
+    if model is not None and callable(model) \
+            and not hasattr(model, "layers"):
+        model = model(machine)
     step, params, state, opt_state = 0, None, None, None
     if ckpt_dir and ckpt.latest_step(ckpt_dir) is not None:
         step, params, state, opt_state = ckpt.restore_checkpoint(

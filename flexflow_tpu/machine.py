@@ -165,6 +165,35 @@ class MachineModel:
                 f"{self.num_devices}-device machine")
         return MachineModel(devices=[self.devices[i] for i in idx])
 
+    def grow(self, returned: Sequence) -> "MachineModel":
+        """The inverse resize primitive: a fresh MachineModel over THIS
+        machine's devices plus ``returned`` — previously-dead device
+        OBJECTS (a shrunk machine no longer holds them, so the elastic
+        runtime carries them from the pre-shrink view and hands them
+        back here once they answer probes again).  Devices are re-sorted
+        into canonical ``id`` order so the grown machine matches the
+        pre-shrink one exactly; the topology is re-derived (a grow can
+        restore ICI groups the shrink broke).  Returns a new model —
+        this one is never mutated (the shrunk view stays valid for
+        migrating state off it)."""
+        extra = list(returned)
+        if not extra:
+            raise ValueError("grow needs at least one returned device")
+        current = {id(d) for d in self.devices}
+        dup = [d for d in extra if id(d) in current]
+        if dup:
+            raise ValueError(
+                f"returned devices {dup} are already part of this "
+                f"{self.num_devices}-device machine")
+        if len({id(d) for d in extra}) != len(extra):
+            raise ValueError("returned devices contain duplicates")
+        devs = list(self.devices) + extra
+        try:
+            devs.sort(key=lambda d: int(getattr(d, "id", d)))
+        except (TypeError, ValueError):
+            pass  # unsortable placeholder devices: keep append order
+        return MachineModel(devices=devs)
+
     def _dev_array(self, shape: Tuple[int, ...],
                    order: Optional[Sequence[int]] = None):
         """Object ndarray of devices in ``order`` (default canonical),

@@ -1307,35 +1307,94 @@ class FFModel:
                   "strategy_ops": len(self.config.strategies)})
         # deterministic fault injection (utils/faultinject.py): installed
         # process-globally for the run so background data threads see the
-        # same schedule; the previous injector is restored on every exit
-        # path (a leaked injector would fire into the next run)
+        # same schedule; the restore callable is idempotent/re-entrant —
+        # the drain path and the error path can both reach it (a leaked
+        # injector would fire into the next run)
         inj = faultinject.from_config(self.config, olog=olog)
-        prev_inj = faultinject.install(inj) if inj.enabled else None
+        restore_inj = faultinject.install_scoped(inj) if inj.enabled \
+            else None
+        # graceful drain (utils/elastic.py): SIGTERM/SIGINT set a flag
+        # the loop reads at its existing boundaries; handlers live only
+        # inside fit and are restored on every exit path
+        drain = {"requested": False, "signum": None}
+        restore_sig = _elastic.install_drain_handler(drain, log)
         try:
             # elastic outer loop (utils/elastic.py): each detected
             # permanent device loss shrinks onto the surviving mesh and
             # CONTINUES the same logical run on the rebuilt model —
-            # prior losses are carried so callers see one history
+            # prior losses are carried so callers see one history.
+            # After a shrink, regrow_ctx tracks the out-of-service
+            # devices; K consecutive healthy boundary probes raise
+            # DeviceReturnDetected and the run grows back (at most
+            # --max-regrows times).
             model = self
             carry = None
             resizes = 0
+            resize_dirs = {"shrink": 0, "grow": 0}
+            regrow_ctx = None
+            regrows = 0
+            max_regrows = max(int(getattr(self.config, "max_regrows", 1)
+                                  or 0), 0)
             prior_losses: List[float] = []
             while True:
                 try:
-                    out = model._fit(data_iter, num_iterations, warmup,
-                                     log, olog, inj,
-                                     elastic_resume=carry,
-                                     elastic_resizes=resizes)
+                    out = model._fit(
+                        data_iter, num_iterations, warmup, log, olog,
+                        inj, elastic_resume=carry,
+                        elastic_resizes=resizes,
+                        elastic_regrow=(regrow_ctx
+                                        if regrows < max_regrows
+                                        else None),
+                        resize_dirs=resize_dirs, drain=drain)
                     if prior_losses:
                         out["loss"] = prior_losses + out["loss"]
                     out["elastic_resizes"] = resizes
                     out["devices"] = model.machine.num_devices
                     return out
                 except _elastic.DeviceLossDetected as sig:
+                    # capture the dead device objects + pre-shrink
+                    # strategy BEFORE recover() shrinks them away
+                    new_ctx = None
+                    if rebuild is not None and regrows < max_regrows:
+                        new_ctx = _elastic.make_regrow_context(
+                            model, sig,
+                            getattr(self.config, "regrow_probes", 2),
+                            prior=regrow_ctx)
                     model, carry, kept = _elastic.recover(
                         model, sig, rebuild, olog=olog, log=log)
+                    regrow_ctx = new_ctx
                     prior_losses = prior_losses + kept
                     resizes += 1
+                    resize_dirs["shrink"] += 1
+                except _elastic.DeviceReturnDetected as sig:
+                    import jax as _jax
+
+                    try:
+                        kept = [float(v) for v in
+                                _jax.device_get(list(sig.losses))]
+                    except Exception:
+                        kept = []
+                    try:
+                        model, carry, _ = _elastic.recover_grow(
+                            model, sig, regrow_ctx, rebuild,
+                            olog=olog, log=log)
+                    except Exception as e:
+                        # growing is an optimization: never kill a
+                        # healthy shrunk run over a failed expansion
+                        olog.event("elastic_fallback", step=sig.step,
+                                   reason=f"regrow failed: {e}")
+                        log(f"elastic: regrow failed ({e}); continuing "
+                            f"on {model.machine.num_devices} devices")
+                        carry = {"start_iter": sig.step,
+                                 "params": sig.params,
+                                 "state": sig.state,
+                                 "opt_state": sig.opt_state}
+                    else:
+                        resizes += 1
+                        resize_dirs["grow"] += 1
+                    regrow_ctx = None
+                    regrows += 1
+                    prior_losses = prior_losses + kept
         except BaseException:
             # error exit must release the multi-host coordinator promptly
             # — a crashed host previously held the barrier until the
@@ -1346,18 +1405,21 @@ class FFModel:
             distributed.release()
             raise
         finally:
-            if prev_inj is not None:
-                faultinject.install(prev_inj)
+            restore_sig()
+            if restore_inj is not None:
+                restore_inj()
             olog.close()
 
     def _fit(self, data_iter, num_iterations, warmup, log, olog, inj,
-             elastic_resume=None, elastic_resizes=0):
+             elastic_resume=None, elastic_resizes=0, elastic_regrow=None,
+             resize_dirs=None, drain=None):
         import contextlib
 
         import jax
 
         from flexflow_tpu.utils import checkpoint as ckpt
-        from flexflow_tpu.utils.health import StepHealthGuard
+        from flexflow_tpu.utils import elastic as _elastic
+        from flexflow_tpu.utils.health import StepHealthGuard, StepWatchdog
 
         if getattr(self.config, "dry_compile", False):
             # DISABLE_COMPUTATION analog (ops.h:19): run the whole graph/
@@ -1448,7 +1510,27 @@ class FFModel:
         # deferred to the next host-sync boundary (zero new syncs), where
         # _raise_device_loss turns them into recovery or a fatal error
         elastic_dead: List[int] = []
+        # transient-retry budget with a windowed refill: the budget (3)
+        # only refills after transient_reset_steps CONSECUTIVE healthy
+        # steps, so a long run absorbs spread-out hiccups while rapid
+        # fail/succeed flapping still exhausts the cap
         transient_retries = 0
+        healthy_streak = 0
+        transient_reset = max(int(getattr(self.config,
+                                          "transient_reset_steps", 16)
+                                  or 0), 0)
+        # step watchdog (utils/health.StepWatchdog): hang detection armed
+        # around the boundary's blocking syncs; off unless --hang-factor
+        # > 0, so healthy default runs carry no timer threads
+        wd = None
+        _hf = float(getattr(self.config, "hang_factor", 0.0) or 0.0)
+        if _hf > 0:
+            wd = StepWatchdog(
+                _hf,
+                min_deadline_s=float(getattr(self.config, "hang_min_s",
+                                             60.0) or 60.0),
+                olog=olog, log=log)
+        hang_pending = False
         # double-buffered device prefetch (data/prefetch.py): host batch
         # prep + sharded H2D of step N+1 overlap step N's compute instead
         # of running synchronously inside the timed loop.  Wrapped AFTER
@@ -1523,6 +1605,10 @@ class FFModel:
         # of the guard's current loss window
         loss_base = start_iter
         window_start = start_iter
+        # watchdog estimate feed + graceful-drain outcome
+        last_boundary_t = start
+        last_boundary_it = start_iter
+        drained_info = None
         try:
             with trace_ctx:
                 it = start_iter
@@ -1542,7 +1628,15 @@ class FFModel:
                         else:
                             params, state, opt_state, loss = step(
                                 params, state, opt_state, *batch)
-                        transient_retries = 0
+                        if transient_retries:
+                            healthy_streak += 1
+                            if transient_reset \
+                                    and healthy_streak >= transient_reset:
+                                transient_retries = 0
+                                healthy_streak = 0
+                                olog.event("recovery", source="elastic",
+                                           after="transient_window",
+                                           step=it + 1)
                     except Exception as e:
                         # device-loss classification (utils/elastic.py):
                         # a runtime error that probes TRANSIENT retries
@@ -1555,6 +1649,7 @@ class FFModel:
                         if outcome != "transient":
                             raise
                         transient_retries += 1
+                        healthy_streak = 0
                         continue
                     if inj.enabled and inj.fire("loss_nan", site="fit"):
                         # poison the RECORDED loss device-side (no host
@@ -1574,6 +1669,14 @@ class FFModel:
                                  if i not in elastic_dead]
                         if alive:
                             elastic_dead.append(alive[-1])
+                    if inj.enabled and inj.fire("preempt", site="fit") \
+                            and drain is not None:
+                        # raise the REAL signal path (graceful drain)
+                        _elastic.request_drain(drain)
+                    if inj.enabled and inj.fire("step_hang", site="fit"):
+                        # wedge the NEXT boundary past the watchdog
+                        # deadline (utils/health.StepWatchdog.stall)
+                        hang_pending = True
                     losses.append(loss)
                     if clock is not None:
                         clock.tick()
@@ -1582,11 +1685,27 @@ class FFModel:
                         and it1 % self.config.print_freq == 0
                     at_ckpt = bool(ckpt_dir) and bool(ckpt_freq) \
                         and it1 % ckpt_freq == 0 and it1 < num_iterations
-                    if at_print or at_ckpt or it1 == num_iterations:
+                    at_boundary = at_print or at_ckpt \
+                        or it1 == num_iterations
+                    if at_boundary:
                         # guard check rides boundaries that host-sync
                         # anyway (print's float(loss), the save's
                         # device_get); the boundary's own host time feeds
                         # the step_budget host_sync bucket
+                        if wd is not None:
+                            # watchdog armed around the boundary's
+                            # blocking syncs; the rolling estimate feeds
+                            # on the inter-boundary wall clock
+                            _now = time.perf_counter()
+                            wd.observe(_now - last_boundary_t,
+                                       it1 - last_boundary_it)
+                            last_boundary_t = _now
+                            last_boundary_it = it1
+                            wd.arm(it1)
+                            if hang_pending:
+                                # injected wedge: block past the deadline
+                                hang_pending = False
+                                wd.stall()
                         if elastic_dead:
                             # injected permanent loss: hand the live loop
                             # state to the elastic wrapper for recovery
@@ -1599,6 +1718,8 @@ class FFModel:
                             first_step=window_start + 1)
                         if action == "rollback":
                             host_sync_s += time.perf_counter() - tb0
+                            if wd is not None:
+                                wd.disarm()
                             if awriter is not None:
                                 # the restore must see the newest commit
                                 awriter.wait()
@@ -1649,6 +1770,29 @@ class FFModel:
                                            step=it1, error=str(e))
                                 log(f"warning: skipped checkpoint at "
                                     f"iteration {it1}: {e}")
+                    if wd is not None and at_boundary:
+                        # the boundary's blocking syncs are done; route a
+                        # deadline expiry into the probe/classify path
+                        # (transient -> keep training, permanent ->
+                        # DeviceLossDetected -> shrink)
+                        _hang = wd.disarm()
+                        if _hang is not None:
+                            self._handle_step_hang(
+                                _hang, it1, params, state, opt_state,
+                                losses, loss_base, olog, log)
+                    if elastic_regrow and at_boundary \
+                            and it1 < num_iterations \
+                            and _elastic.probe_regrow(
+                                elastic_regrow, inj=inj, olog=olog,
+                                log=log):
+                        # K consecutive healthy probes: hand the live
+                        # state to the elastic wrapper for re-expansion
+                        raise _elastic.DeviceReturnDetected(
+                            [_elastic._device_ordinal(d)
+                             for d, _ in elastic_regrow["dead"]],
+                            it1, params=params, state=state,
+                            opt_state=opt_state, losses=losses,
+                            loss_base=loss_base)
                     if metrics is not None and (at_print or at_ckpt):
                         # refresh the scrape at a boundary that just
                         # synced
@@ -1656,7 +1800,21 @@ class FFModel:
                             metrics, olog, step, params, state, opt_state,
                             batch, losses, it1, warmup, start, guard,
                             prefetcher, fault_count, awriter=awriter,
-                            elastic_resizes=elastic_resizes)
+                            elastic_resizes=elastic_resizes,
+                            resize_dirs=resize_dirs,
+                            draining=bool(drain
+                                          and drain.get("requested")))
+                    if drain is not None and drain.get("requested") \
+                            and at_boundary and it1 < num_iterations:
+                        # graceful drain: the in-flight step finished;
+                        # commit a final verified checkpoint within the
+                        # wall budget, record it, and leave cleanly
+                        drained_info = self._drain_checkpoint(
+                            ckpt_dir, awriter, it1, start_iter, params,
+                            state, opt_state, drain, olog, log,
+                            just_saved=at_ckpt)
+                        it += 1
+                        break
                     it += 1
                 if loss is not None:
                     float(loss)
@@ -1671,12 +1829,19 @@ class FFModel:
                 prefetcher.close()
             if awriter is not None:
                 awriter.close(timeout=5.0)
+            if wd is not None:
+                wd.close()
             raise
         if prefetcher is not None:
             # stop the staging thread before post-loop work; an
             # exceptional exit closes it via DevicePrefetcher.__del__
             prefetcher.close()
-        if ckpt_dir and start_iter < num_iterations:
+        if wd is not None:
+            # cancel + join any armed timer so no watchdog thread
+            # outlives the fit (the thread-leak checks assert this)
+            wd.close()
+        if ckpt_dir and start_iter < num_iterations \
+                and drained_info is None:
             t0 = time.perf_counter()
             if awriter is not None:
                 # the final save is the one write fit() blocks on: a
@@ -1699,9 +1864,12 @@ class FFModel:
                     log(f"warning: skipped final checkpoint: {e}")
         if awriter is not None:
             awriter.close()
-        # the one bulk device->host transfer of the whole loss history
+        # the one bulk device->host transfer of the whole loss history.
+        # end_step: last completed iteration (num_iterations normally;
+        # the drained step after a graceful drain)
+        end_step = it
         losses = [float(l) for l in jax.device_get(losses)]
-        n_timed = num_iterations - warmup
+        n_timed = end_step - warmup
         throughput = (n_timed * self.config.batch_size / elapsed
                       if elapsed > 0 and n_timed > 0 else 0.0)
         log(f"time = {elapsed:.4f}s, tp = {throughput:.2f} images/s")
@@ -1710,20 +1878,22 @@ class FFModel:
             # ONLY write for runs whose print/ckpt frequency never fired)
             self._metrics_update(metrics, olog, step, params, state,
                                  opt_state, batch if losses else None,
-                                 losses, num_iterations, warmup, start,
+                                 losses, end_step, warmup, start,
                                  guard, prefetcher, fault_count,
                                  elapsed=elapsed, throughput=throughput,
                                  awriter=awriter,
-                                 elastic_resizes=elastic_resizes)
+                                 elastic_resizes=elastic_resizes,
+                                 resize_dirs=resize_dirs,
+                                 draining=drained_info is not None)
         if olog.enabled:
             budget_totals = {
                 "host_sync_s": host_sync_s, "checkpoint_s": ckpt_io_s,
                 "input_stall_s": prefetcher.stall_s if prefetcher else 0.0,
                 "input_batches": prefetcher.batches if prefetcher else 0,
-                "steps": num_iterations - start_iter,
+                "steps": end_step - start_iter,
             }
             self._emit_fit_records(olog, clock, losses, start_iter, warmup,
-                                   num_iterations, elapsed, throughput,
+                                   end_step, elapsed, throughput,
                                    step, params, state, opt_state,
                                    batch if losses else None, op_samples,
                                    sample_every, budget_totals)
@@ -1763,7 +1933,7 @@ class FFModel:
                 except Exception as e:
                     log(f"step roofline unavailable: {e}")
             log(OpProfiler(self).report())
-        return {
+        out = {
             "params": params, "state": state,
             "loss": losses,
             "elapsed_s": elapsed, "images_per_sec": throughput,
@@ -1773,7 +1943,12 @@ class FFModel:
             else 0,
             "run_id": olog.run_id, "obs_path": olog.path,
             "metrics_path": metrics.path if metrics is not None else "",
+            "completed_steps": end_step,
         }
+        if drained_info is not None:
+            out["drained"] = True
+            out["drain"] = drained_info
+        return out
 
     def _raise_device_loss(self, dead, step, params, state, opt_state,
                            losses, loss_base):
@@ -1785,11 +1960,106 @@ class FFModel:
         if getattr(self.config, "elastic", False):
             raise elastic.DeviceLossDetected(
                 dead=dead, step=step, params=params, state=state,
-                opt_state=opt_state, losses=losses, loss_base=loss_base)
+                opt_state=opt_state, losses=losses, loss_base=loss_base,
+                injected=True)
         raise elastic.DeviceLostError(
             f"permanent device loss at iteration {step} (ordinals "
             f"{sorted(set(dead))}); run with --elastic to recover on "
             f"the surviving mesh")
+
+    def _handle_step_hang(self, info, step, params, state, opt_state,
+                          losses, loss_base, olog, log):
+        """Route a step-watchdog expiry (utils/health.StepWatchdog) into
+        the elastic probe/classify path once the wedged boundary finally
+        returned: dead probes raise :class:`DeviceLossDetected` into the
+        shrink recovery, healthy probes mean the hang was transient and
+        training continues."""
+        from flexflow_tpu.utils import elastic
+
+        if not getattr(self.config, "elastic", False):
+            raise elastic.DeviceLostError(
+                f"boundary at iteration {step} exceeded the step "
+                f"watchdog deadline ({info['deadline_s']:.1f}s); run "
+                f"with --elastic to probe and recover instead of "
+                f"failing")
+        live, dead, transient = elastic.probe_devices(self.machine,
+                                                      olog=olog)
+        if dead:
+            raise elastic.DeviceLossDetected(
+                dead=dead, step=step, params=params, state=state,
+                opt_state=opt_state, losses=losses, loss_base=loss_base)
+        olog.event("device_loss", step=step, classification="transient",
+                   transient=transient, source="watchdog",
+                   deadline_s=info["deadline_s"])
+        log(f"watchdog: iteration {step} boundary returned past its "
+            f"{info['deadline_s']:.1f}s deadline but every device "
+            f"probes healthy — continuing")
+
+    def _drain_checkpoint(self, ckpt_dir, awriter, step, start_iter,
+                          params, state, opt_state, drain, olog, log,
+                          just_saved=False):
+        """Commit the graceful-drain checkpoint within the
+        ``--drain-budget-s`` wall budget (async writer wait with a
+        best-effort sync-save fallback), emit the single
+        ``preempt_drain`` record, and release the multi-host
+        coordinator.  Returns the record dict (the ``drain`` entry of
+        fit()'s result)."""
+        from flexflow_tpu import distributed
+        from flexflow_tpu.utils import checkpoint as ckpt
+
+        t0 = time.perf_counter()
+        budget = float(getattr(self.config, "drain_budget_s", 60.0)
+                       or 60.0)
+        mode = "none"
+        ckpt_step = None
+        if ckpt_dir:
+            if awriter is not None:
+                if not just_saved:
+                    awriter.submit(ckpt_dir, step, params, state,
+                                   opt_state, self.config.strategies)
+                left = max(budget - (time.perf_counter() - t0), 0.05)
+                if awriter.wait(timeout=left):
+                    mode, ckpt_step = "async", step
+                else:
+                    log(f"drain: async writer missed the {budget:.0f}s "
+                        f"budget; falling back to a best-effort sync "
+                        f"save")
+                    try:
+                        ckpt.save_checkpoint(ckpt_dir, step, params,
+                                             state, opt_state,
+                                             self.config.strategies)
+                        mode, ckpt_step = "sync_fallback", step
+                    except Exception as e:
+                        log(f"warning: drain checkpoint failed: {e}")
+                        mode = "failed"
+            elif just_saved:
+                # this boundary's synchronous save already committed
+                mode, ckpt_step = "boundary_save", step
+            else:
+                try:
+                    ckpt.save_checkpoint(ckpt_dir, step, params, state,
+                                         opt_state,
+                                         self.config.strategies)
+                    olog.event("checkpoint_save", step=step,
+                               seconds=time.perf_counter() - t0,
+                               dir=ckpt_dir)
+                    mode, ckpt_step = "sync", step
+                except Exception as e:
+                    log(f"warning: drain checkpoint failed: {e}")
+                    mode = "failed"
+        seconds = time.perf_counter() - t0
+        info = {"step": step, "steps_completed": step,
+                "ckpt_step": ckpt_step, "signal": drain.get("signum"),
+                "seconds": seconds, "budget_s": budget, "mode": mode}
+        olog.event("preempt_drain", **info)
+        at = (f"checkpoint at step {ckpt_step}" if ckpt_step is not None
+              else "no checkpoint")
+        log(f"drain: stopped cleanly at iteration {step} ({at}, "
+            f"{seconds:.2f}s of the {budget:.0f}s budget, mode {mode})")
+        # a draining host must release its coordinator slot promptly —
+        # idempotent with the error path's release
+        distributed.release()
+        return info
 
     def _classify_step_error(self, e, step, olog, losses, loss_base,
                              transient_retries):
@@ -1982,7 +2252,8 @@ class FFModel:
                         opt_state, batch, losses, it1, warmup, start_t,
                         guard, prefetcher, fault_count, elapsed=None,
                         throughput=None, awriter=None,
-                        elastic_resizes=0):
+                        elastic_resizes=0, resize_dirs=None,
+                        draining=False):
         """Refresh and publish the live gauges (obs/metrics.py) at a
         boundary that already host-synced.  Every input is host-resident
         or memoized; the one potentially non-trivial call (compiled cost
@@ -2038,8 +2309,14 @@ class FFModel:
             faults_total=fault_count + (awriter.faults
                                         if awriter is not None else 0),
             elastic_events=elastic_resizes,
+            drain_pending=1.0 if draining else 0.0,
             ckpt_async_inflight=(awriter.inflight
                                  if awriter is not None else 0))
+        for direction in ("shrink", "grow"):
+            # per-direction labeled series alongside the plain total
+            metrics.update_labeled(
+                "elastic_events", {"direction": direction},
+                (resize_dirs or {}).get(direction, 0))
         try:
             metrics.write()
         except OSError as e:

@@ -65,6 +65,13 @@ class TransformerConfig:
     min_devices: int = 1
     research_budget_s: float = 30.0
     ckpt_async: bool = False
+    # elastic re-expansion / graceful drain / step watchdog (round 9)
+    max_regrows: int = 1
+    regrow_probes: int = 2
+    drain_budget_s: float = 60.0
+    hang_factor: float = 0.0
+    hang_min_s: float = 60.0
+    transient_reset_steps: int = 16
 
 
 class TransformerLM(FFModel):
@@ -101,6 +108,12 @@ class TransformerLM(FFModel):
             min_devices=self.t.min_devices,
             research_budget_s=self.t.research_budget_s,
             ckpt_async=self.t.ckpt_async,
+            max_regrows=self.t.max_regrows,
+            regrow_probes=self.t.regrow_probes,
+            drain_budget_s=self.t.drain_budget_s,
+            hang_factor=self.t.hang_factor,
+            hang_min_s=self.t.hang_min_s,
+            transient_reset_steps=self.t.transient_reset_steps,
             strategies=strategies or Strategy(),
         )
         super().__init__(ff_cfg, machine)

@@ -76,6 +76,13 @@ class RnnConfig:
     min_devices: int = 1
     research_budget_s: float = 30.0
     ckpt_async: bool = False
+    # elastic re-expansion / graceful drain / step watchdog (round 9)
+    max_regrows: int = 1
+    regrow_probes: int = 2
+    drain_budget_s: float = 60.0
+    hang_factor: float = 0.0
+    hang_min_s: float = 60.0
+    transient_reset_steps: int = 16
 
     @property
     def chunks_per_seq(self) -> int:
@@ -172,6 +179,12 @@ class RnnModel(FFModel):
             min_devices=self.rnn.min_devices,
             research_budget_s=self.rnn.research_budget_s,
             ckpt_async=self.rnn.ckpt_async,
+            max_regrows=self.rnn.max_regrows,
+            regrow_probes=self.rnn.regrow_probes,
+            drain_budget_s=self.rnn.drain_budget_s,
+            hang_factor=self.rnn.hang_factor,
+            hang_min_s=self.rnn.hang_min_s,
+            transient_reset_steps=self.rnn.transient_reset_steps,
             strategies=strategies,
         )
         super().__init__(ff_cfg, machine)
@@ -270,10 +283,10 @@ class RnnModel(FFModel):
         return None  # plain SGD carries no state; skip the momentum buffers
 
     def fit(self, data_iter, num_iterations: Optional[int] = None,
-            warmup: int = 1, log=print):
+            warmup: int = 1, log=print, rebuild=None):
         out = super().fit(data_iter,
                           num_iterations or self.rnn.num_iterations,
-                          warmup, log)
+                          warmup, log, rebuild=rebuild)
         out["sentences_per_sec"] = out["images_per_sec"]
         return out
 

@@ -62,10 +62,13 @@ _HELP = {
     "rollbacks_total": "health-guard rollbacks this run",
     "faults_total": "fault records this run (injected, detected, or "
                     "refused-checkpoint)",
-    "elastic_events": "elastic resizes (surviving-mesh recoveries) this "
-                      "run",
+    "elastic_events": "elastic resizes (surviving-mesh recoveries and "
+                      "re-expansions) this run; also exported per "
+                      "direction as ff_elastic_events{direction=...}",
     "ckpt_async_inflight": "async checkpoint writes currently in flight "
                            "(0 or 1)",
+    "drain_pending": "1 while a SIGTERM/SIGINT graceful drain is "
+                     "committing its final checkpoint, else 0",
 }
 _COUNTERS = {"steps_total", "rollbacks_total", "faults_total",
              "prefetch_stall_seconds_total", "elastic_events"}
@@ -94,6 +97,10 @@ class MetricsExporter:
         self.meta = dict(meta or {})
         self.cache: Dict = {}
         self.values: Dict[str, float] = {}
+        # labeled series: bare name -> {rendered label string -> value};
+        # published right after the same-named plain series (which stays
+        # the all-directions total, so unlabeled dashboards keep working)
+        self.labeled: Dict[str, Dict[str, float]] = {}
         self._writes = 0
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
@@ -101,6 +108,14 @@ class MetricsExporter:
     def update(self, **gauges) -> None:
         for k, v in gauges.items():
             self.values[k] = v
+
+    def update_labeled(self, name: str, labels: Dict[str, str],
+                       value) -> None:
+        """Set one labeled sample, e.g. ``update_labeled("elastic_events",
+        {"direction": "grow"}, 1)`` ->
+        ``ff_elastic_events{direction="grow"} 1``."""
+        key = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        self.labeled.setdefault(name, {})[key] = value
 
     def finite_values(self) -> Dict[str, float]:
         out = {}
@@ -119,15 +134,21 @@ class MetricsExporter:
             lines.append(f"# HELP {PREFIX}run_info run identity labels")
             lines.append(f"# TYPE {PREFIX}run_info gauge")
             lines.append(f"{PREFIX}run_info{{{labels}}} 1")
-        ordered = [k for k in _HELP if k in vals] \
-            + sorted(k for k in vals if k not in _HELP)
+        extra = set(vals) | set(self.labeled)
+        ordered = [k for k in _HELP if k in extra] \
+            + sorted(k for k in extra if k not in _HELP)
         for k in ordered:
             name = PREFIX + k
             if k in _HELP:
                 lines.append(f"# HELP {name} {_HELP[k]}")
             lines.append(f"# TYPE {name} "
                          f"{'counter' if k in _COUNTERS else 'gauge'}")
-            lines.append(f"{name} {vals[k]:.10g}")
+            if k in vals:
+                lines.append(f"{name} {vals[k]:.10g}")
+            for labels, v in sorted(self.labeled.get(k, {}).items()):
+                f = _finite(v)
+                if f is not None:
+                    lines.append(f"{name}{{{labels}}} {f:.10g}")
         return "\n".join(lines) + "\n"
 
     def write(self) -> None:
@@ -182,8 +203,31 @@ def read_textfile(path: str) -> Dict[str, float]:
                 raise ValueError(f"malformed metrics line: {line!r}")
             name, value = parts
             if "{" in name:
-                continue  # labeled info series
+                continue  # labeled series (see read_labeled)
             if not name.startswith(PREFIX):
                 raise ValueError(f"unexpected metric name: {name!r}")
             out[name[len(PREFIX):]] = float(value)
+    return out
+
+
+def read_labeled(path: str) -> Dict[str, Dict[str, float]]:
+    """Parse the LABELED samples of a textfile back into
+    ``{bare_name: {label_string: value}}`` (e.g.
+    ``{"elastic_events": {'direction="grow"': 1.0}}``), skipping the
+    ``run_info`` identity line — the verification half of
+    :meth:`MetricsExporter.update_labeled`."""
+    out: Dict[str, Dict[str, float]] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "{" not in line:
+                continue
+            head, _, rest = line.partition("{")
+            labels, _, value = rest.rpartition("}")
+            if not head.startswith(PREFIX):
+                raise ValueError(f"unexpected metric name: {head!r}")
+            bare = head[len(PREFIX):]
+            if bare == "run_info":
+                continue
+            out.setdefault(bare, {})[labels] = float(value.strip())
     return out

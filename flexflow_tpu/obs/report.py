@@ -179,18 +179,22 @@ def _fit_section(events: List[Dict]) -> List[str]:
 
 def _elastic_section(events: List[Dict]) -> List[str]:
     """The elastic-runtime records: device-loss detections/probes,
-    resizes (loss detected -> re-search time -> regrid bytes/hops ->
-    steps lost), fallbacks/refusals, rejoins, async checkpoint
-    commits."""
+    resizes in BOTH directions (loss detected -> re-search time ->
+    regrid bytes/hops -> steps lost; device return -> regrow),
+    step hangs, preemption drains, fallbacks/refusals, rejoins, async
+    checkpoint commits."""
     losses = [e for e in events if e.get("kind") == "device_loss"]
     probes = [e for e in events if e.get("kind") == "device_probe"]
     resizes = [e for e in events if e.get("kind") == "elastic_resize"]
+    returns = [e for e in events if e.get("kind") == "device_return"]
+    hangs = [e for e in events if e.get("kind") == "step_hang"]
+    drains = [e for e in events if e.get("kind") == "preempt_drain"]
     fallbacks = [e for e in events if e.get("kind") == "elastic_fallback"]
     refused = [e for e in events if e.get("kind") == "elastic_refused"]
     rejoins = [e for e in events if e.get("kind") == "elastic_rejoin"]
     asyncs = [e for e in events if e.get("kind") == "ckpt_async"]
-    if not (losses or resizes or fallbacks or refused or rejoins
-            or asyncs):
+    if not (losses or resizes or returns or hangs or drains or fallbacks
+            or refused or rejoins or asyncs):
         return []
     lines = ["== elastic =="]
     for d in losses:
@@ -199,11 +203,24 @@ def _elastic_section(events: List[Dict]) -> List[str]:
         lines.append(f"  device_loss[{d.get('classification', '?')}] at "
                      f"step {d.get('step', '?')}: {what} "
                      f"({d.get('live', '?')} live)")
+    for h in hangs:
+        lines.append(f"  step_hang at step {h.get('step', '?')}: "
+                     f"deadline {_fmt_s(h.get('deadline_s', 0.0))} "
+                     f"(estimate {_fmt_s(h.get('estimate_s', 0.0))}, "
+                     f"factor {h.get('factor', '?')})")
+    for r in returns:
+        lines.append(f"  device_return at step {r.get('step', '?')}: "
+                     f"ordinals {r.get('returned', '?')} back after "
+                     f"{r.get('probes', '?')} probe(s)")
     dead_probes = [p for p in probes if p.get("outcome") == "dead"]
     trans_probes = [p for p in probes if p.get("outcome") == "transient"]
+    regrow_probes = [p for p in probes
+                     if p.get("outcome") in ("answering", "out")]
     if probes:
         lines.append(f"  probes: {len(dead_probes)} dead, "
-                     f"{len(trans_probes)} transient recoveries")
+                     f"{len(trans_probes)} transient recoveries"
+                     + (f", {len(regrow_probes)} regrow"
+                        if regrow_probes else ""))
     for f in fallbacks:
         lines.append(f"  fallback to checkpoint at step "
                      f"{f.get('step', '?')}: {f.get('reason', '?')}")
@@ -217,14 +234,27 @@ def _elastic_section(events: List[Dict]) -> List[str]:
         if r.get("regrid_bytes") is not None:
             regrid = (f", regrid {r['regrid_bytes'] / 1e6:.2f} MB / "
                       f"{r.get('regrid_hops', 0)} hops")
+        direction = r.get("direction") or (
+            "grow" if r.get("to_devices", 0) > r.get("from_devices", 0)
+            else "shrink")
         lines.append(
-            f"  elastic_resize: {r.get('from_devices', '?')} -> "
+            f"  elastic_resize[{direction}]: "
+            f"{r.get('from_devices', '?')} -> "
             f"{r.get('to_devices', '?')} devices at step "
             f"{r.get('step', '?')} (re-search "
             f"{_fmt_s(r.get('research_s', 0.0))} "
             f"[{research.get('mode', '?')}], migration "
             f"{r.get('migration', '?')}{regrid}, "
             f"{r.get('steps_lost', 0)} step(s) lost)")
+    for d in drains:
+        at = (f"checkpoint at step {d['ckpt_step']}"
+              if d.get("ckpt_step") is not None else "no checkpoint")
+        lines.append(
+            f"  preempt_drain at step {d.get('step', '?')}: "
+            f"{d.get('steps_completed', '?')} step(s) completed, {at} "
+            f"({_fmt_s(d.get('seconds', 0.0))} of "
+            f"{_fmt_s(d.get('budget_s', 0.0))} budget, mode "
+            f"{d.get('mode', '?')})")
     for r in rejoins:
         lines.append(f"  rejoin: step {r.get('step', '?')} on "
                      f"{r.get('devices', '?')} devices "
@@ -424,6 +454,7 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "ckpt_fallback", "thread_leak",
              "device_loss", "device_probe", "elastic_resize",
              "elastic_fallback", "elastic_refused", "elastic_rejoin",
+             "device_return", "step_hang", "preempt_drain",
              "ckpt_async"}
     lines = []
     for e in events:
@@ -611,7 +642,8 @@ def summarize(events: Iterable[Dict]) -> Dict:
         }
     elastic_kinds = ("device_loss", "device_probe", "elastic_resize",
                      "elastic_fallback", "elastic_refused",
-                     "elastic_rejoin", "ckpt_async")
+                     "elastic_rejoin", "device_return", "step_hang",
+                     "preempt_drain", "ckpt_async")
     if any(kinds.get(k) for k in elastic_kinds):
         el: Dict = {"counts": {k: kinds[k] for k in elastic_kinds
                                if kinds.get(k)}}
@@ -619,6 +651,9 @@ def summarize(events: Iterable[Dict]) -> Dict:
         if resizes:
             el["resizes"] = [
                 {"step": r.get("step"),
+                 "direction": r.get("direction") or (
+                     "grow" if (r.get("to_devices") or 0)
+                     > (r.get("from_devices") or 0) else "shrink"),
                  "from_devices": r.get("from_devices"),
                  "to_devices": r.get("to_devices"),
                  "research_s": r.get("research_s"),
@@ -633,6 +668,28 @@ def summarize(events: Iterable[Dict]) -> Dict:
                 {"step": d.get("step"),
                  "classification": d.get("classification"),
                  "dead": d.get("dead")} for d in dl]
+        hangs = [e for e in events if e.get("kind") == "step_hang"]
+        if hangs:
+            el["step_hangs"] = [
+                {"step": h.get("step"),
+                 "deadline_s": h.get("deadline_s"),
+                 "estimate_s": h.get("estimate_s")} for h in hangs]
+        rets = [e for e in events if e.get("kind") == "device_return"]
+        if rets:
+            el["device_returns"] = [
+                {"step": r.get("step"),
+                 "returned": r.get("returned"),
+                 "probes": r.get("probes")} for r in rets]
+        drains = [e for e in events if e.get("kind") == "preempt_drain"]
+        if drains:
+            d = drains[-1]
+            el["preempt_drain"] = {
+                "step": d.get("step"),
+                "ckpt_step": d.get("ckpt_step"),
+                "signal": d.get("signal"),
+                "seconds": d.get("seconds"),
+                "budget_s": d.get("budget_s"),
+                "mode": d.get("mode")}
         asyncs = [e for e in events if e.get("kind") == "ckpt_async"]
         if asyncs:
             commits = sorted(float(a.get("commit_s", 0.0))

@@ -329,15 +329,25 @@ def _load_step(ckpt_dir: str, step: int, model=None
                 if s:
                     shardings[op.param_key] = s
 
+        def put(v, shard):
+            if shard is None:
+                return jax.device_put(v)
+            if getattr(shard, "is_fully_addressable", True):
+                return jax.device_put(v, shard)
+            # multi-host restore (elastic_rejoin): device_put cannot
+            # scatter a host array onto devices owned by other
+            # processes; build the global array from each process's
+            # local shards instead — every host loaded the same file
+            arr = np.asarray(v)
+            return jax.make_array_from_callback(
+                arr.shape, shard, lambda idx: arr[idx])
+
         def place(tree):
             placed = {}
             for key, sub in tree.items():
                 ops_shard = shardings.get(key, {})
-                placed[key] = {
-                    k: jax.device_put(v, ops_shard[k]) if k in ops_shard
-                    else jax.device_put(v)
-                    for k, v in sub.items()
-                }
+                placed[key] = {k: put(v, ops_shard.get(k))
+                               for k, v in sub.items()}
             return placed
 
         params = place(params)

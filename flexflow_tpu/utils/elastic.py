@@ -43,6 +43,33 @@ exercising fit()'s error-exit cleanup (coordinator release via
 ``distributed.release`` — a crashed host must not hold the barrier until
 timeout) and the ``--elastic`` restart protocol
 (``distributed.elastic_rejoin``).
+
+Elastic re-expansion + graceful drain (round 9) — the other half of the
+lifecycle:
+
+  4. **re-expansion** — after a shrink, fit() keeps a regrow context
+     (:func:`make_regrow_context`) holding the out-of-service device
+     OBJECTS and the pre-shrink strategy; every existing host-sync
+     boundary runs one bounded probe of them (:func:`probe_regrow` —
+     zero new per-step syncs).  After ``--regrow-probes`` CONSECUTIVE
+     healthy probes (flapping devices are debounced; a failed probe
+     resets the streak) the loop raises :class:`DeviceReturnDetected`
+     and :func:`recover_grow` rebuilds the full machine
+     (``MachineModel.grow``), re-searches warm-started from the
+     PRE-SHRINK strategy (surviving entries fall back to the running
+     shrunk one), and migrates live state — the exact inverse of
+     :func:`recover`, with one ``elastic_resize`` record whose
+     ``direction`` is ``"grow"``.  ``--max-regrows`` caps expansions per
+     run; the injected path is ``device_return@N`` (counted per probe);
+  5. **preemption-aware graceful drain** — fit() installs a
+     SIGTERM/SIGINT handler (:func:`install_drain_handler`, main thread
+     only, restored on every exit path) that sets a flag read at the
+     same boundaries; the loop finishes the in-flight step, commits a
+     final verified checkpoint within ``--drain-budget-s`` (async
+     writer, sync fallback), emits one ``preempt_drain`` record,
+     releases the coordinator and returns cleanly — the driver exits 0,
+     which schedulers must treat as a successful drain, not a failure.
+     ``preempt@N`` injection raises the same signal path.
 """
 
 from __future__ import annotations
@@ -86,7 +113,8 @@ class DeviceLossDetected(Exception):
     be None when the step's donated buffers are unreachable)."""
 
     def __init__(self, dead: Sequence[int], step: int, params=None,
-                 state=None, opt_state=None, losses=(), loss_base: int = 0):
+                 state=None, opt_state=None, losses=(), loss_base: int = 0,
+                 injected: bool = False):
         self.dead = sorted(set(int(d) for d in dead))
         self.step = int(step)
         self.params = params
@@ -94,8 +122,34 @@ class DeviceLossDetected(Exception):
         self.opt_state = opt_state
         self.losses = list(losses)
         self.loss_base = int(loss_base)
+        # injected deaths have no real probe target: the regrow context
+        # gates their return on the ``device_return`` injection instead
+        self.injected = bool(injected)
         super().__init__(
             f"permanent device loss at step {step}: ordinals {self.dead}")
+
+
+class DeviceReturnDetected(Exception):
+    """Internal control-flow signal, the mirror of
+    :class:`DeviceLossDetected`: fit()'s loop raises it at a host-sync
+    boundary once the regrow probe has seen every out-of-service device
+    answer for K consecutive probes; fit()'s elastic wrapper catches it
+    and runs :func:`recover_grow`.  Raised only at HEALTHY boundaries, so
+    the live state is always reachable (no checkpoint fallback needed)."""
+
+    def __init__(self, returned: Sequence[int], step: int, params=None,
+                 state=None, opt_state=None, losses=(),
+                 loss_base: int = 0):
+        self.returned = sorted(set(int(d) for d in returned))
+        self.step = int(step)
+        self.params = params
+        self.state = state
+        self.opt_state = opt_state
+        self.losses = list(losses)
+        self.loss_base = int(loss_base)
+        super().__init__(
+            f"device return at step {step}: ordinals {self.returned} "
+            f"answering again")
 
 
 # substrings (lowercased) of runtime errors that indicate the DEVICE —
@@ -226,12 +280,14 @@ def gather_state(model, params, state, opt_state) -> Tuple[Dict, Dict,
     return _reassemble_trees(model, params, state, opt_state)
 
 
-def warm_assignment(search, strategy) -> List[int]:
-    """Candidate index per op seeding the surviving-mesh re-search from
-    the RUNNING strategy: entries whose (dims, devices) survive among the
-    op's candidates on the new machine keep their config; everything else
-    — dead-device placements, grids the smaller machine cannot host —
-    falls back to the DP default (the invalidation the tentpole names)."""
+def warm_assignment(search, strategy, fallback=None) -> List[int]:
+    """Candidate index per op seeding a re-search from a known-good
+    strategy: entries whose (dims, devices) survive among the op's
+    candidates on the new machine keep their config; everything else —
+    dead-device placements, grids the new machine cannot host — falls
+    back first to ``fallback`` (the RUNNING shrunk strategy on the grow
+    path, where ``strategy`` is the cached pre-shrink one), then to the
+    DP default (the invalidation the tentpole names)."""
     from flexflow_tpu.sim.search import _InputSource
 
     dp = search.dp_assignment()
@@ -239,27 +295,35 @@ def warm_assignment(search, strategy) -> List[int]:
     kept = 0
     for op, cands, dflt in zip(search.ops, search.candidates, dp):
         idx = dflt
-        if not isinstance(op, _InputSource) and strategy is not None:
-            pc = strategy.get(op.name)
-            if pc is not None:
-                for i, c in enumerate(cands):
-                    if c.dims == pc.dims and c.devices == pc.devices:
-                        idx = i
-                        kept += 1
-                        break
+        if not isinstance(op, _InputSource):
+            for strat in (strategy, fallback):
+                if strat is None:
+                    continue
+                pc = strat.get(op.name)
+                if pc is None:
+                    continue
+                hit = next((i for i, c in enumerate(cands)
+                            if c.dims == pc.dims and c.devices == pc.devices),
+                           None)
+                if hit is not None:
+                    idx = hit
+                    kept += 1
+                    break
         out.append(idx)
     return out
 
 
 def research_strategy(config, rebuild, new_machine, old_strategy,
-                      olog=None, log=print):
-    """Re-run the native MCMC search for the surviving mesh under the
-    ``--research-budget-s`` wall clock, warm-started from the running
-    strategy.  Degrades gracefully: when the native simulator (or the
-    search itself) is unavailable, the surviving mesh trains pure-DP —
-    a correct plan, just not a searched one.  Returns
-    ``(Strategy, info dict)``; ``info["mode"]`` is ``"mcmc"`` or
-    ``"dp_fallback"``."""
+                      olog=None, log=print, fallback_strategy=None):
+    """Re-run the native MCMC search for the resized mesh under the
+    ``--research-budget-s`` wall clock, warm-started from
+    ``old_strategy`` (entries missing there fall back to
+    ``fallback_strategy`` — on the grow path the cached pre-shrink
+    strategy is primary and the running shrunk one the fallback).
+    Degrades gracefully: when the native simulator (or the search
+    itself) is unavailable, the mesh trains pure-DP — a correct plan,
+    just not a searched one.  Returns ``(Strategy, info dict)``;
+    ``info["mode"]`` is ``"mcmc"`` or ``"dp_fallback"``."""
     import copy
 
     from flexflow_tpu.strategy import Strategy
@@ -273,8 +337,12 @@ def research_strategy(config, rebuild, new_machine, old_strategy,
         shell_cfg.strategies = Strategy()
         shell = rebuild(shell_cfg, new_machine)
         ss = StrategySearch(shell, machine=new_machine, obs=olog)
-        start = warm_assignment(ss, old_strategy) \
-            if old_strategy is not None and len(old_strategy) else None
+        warm = old_strategy if old_strategy is not None \
+            and len(old_strategy) else None
+        warm_fb = fallback_strategy if fallback_strategy is not None \
+            and len(fallback_strategy) else None
+        start = warm_assignment(ss, warm, fallback=warm_fb) \
+            if warm is not None or warm_fb is not None else None
         strategy, info = ss.search(
             iters=iters, seed=int(getattr(config, "seed", 0)),
             chunks=8, chains=max(int(getattr(config, "search_chains", 1)),
@@ -415,7 +483,7 @@ def recover(model, sig: DeviceLossDetected, rebuild, olog=None,
         prior = prior[:max(resume_step - sig.loss_base, 0)]
 
     rec = {
-        "step": sig.step, "from_devices": n_old,
+        "step": sig.step, "direction": "shrink", "from_devices": n_old,
         "to_devices": len(live), "dead": sorted(dead),
         "research_s": research_s, "research": research,
         "migration": "in_memory" if migrated else "checkpoint",
@@ -434,3 +502,245 @@ def recover(model, sig: DeviceLossDetected, rebuild, olog=None,
     carry = {"start_iter": resume_step, "params": params, "state": state,
              "opt_state": opt_state}
     return new_model, carry, prior
+
+
+# ---------------------------------------------------------------------------
+# re-expansion (regrow)
+
+
+def make_regrow_context(model, sig: DeviceLossDetected,
+                        probes_needed: int, prior=None) -> Dict:
+    """The state fit() carries between boundaries while devices are out:
+    the dead device OBJECTS (shrink drops them from the machine, so they
+    must be captured from the PRE-shrink model) plus the pre-shrink
+    strategy the grow re-search warm-starts from.  ``prior`` merges an
+    earlier context (a second shrink while the first set is still out):
+    the union of out-of-service devices returns together."""
+    devs = []
+    for o in sig.dead:
+        if 0 <= o < model.machine.num_devices:
+            devs.append((model.machine.devices[o], bool(sig.injected)))
+    if prior:
+        devs = list(prior.get("dead", ())) + devs
+    ctx = {
+        "dead": devs,
+        "pre_strategy": getattr(model.config, "strategies", None),
+        "healthy": 0,
+        "probes": 0,
+        "k": max(int(probes_needed), 1),
+        "answering": False,
+    }
+    if prior and prior.get("pre_strategy") is not None:
+        # the FIRST shrink's strategy describes the full machine
+        ctx["pre_strategy"] = prior["pre_strategy"]
+    return ctx
+
+
+def _device_ordinal(dev) -> int:
+    try:
+        return int(getattr(dev, "id", dev))
+    except (TypeError, ValueError):
+        return -1
+
+
+def probe_regrow(ctx: Dict, inj=None, olog=None, probe=None,
+                 log=print) -> bool:
+    """One boundary probe of the out-of-service devices.  Injected-dead
+    devices (no real hardware went away) answer once the injector fires
+    ``device_return`` — one ``fire()`` per probe, so ``device_return@2``
+    means "the 2nd regrow probe".  Real dead devices get one real probe
+    each (no retries here: the K-consecutive streak IS the debounce).
+    All answering increments the healthy streak, any miss resets it to
+    zero (flapping).  True once the streak reaches ``ctx["k"]``."""
+    from flexflow_tpu import obs
+
+    olog = olog if olog is not None else obs.NULL
+    if not ctx or not ctx.get("dead"):
+        return False
+    ctx["probes"] += 1
+    has_injected = any(is_inj for _, is_inj in ctx["dead"])
+    if has_injected and inj is not None and getattr(inj, "enabled", False):
+        if inj.fire("device_return", site="fit.regrow_probe"):
+            ctx["answering"] = True
+    probe = probe or _default_probe
+    ok = True
+    for dev, is_inj in ctx["dead"]:
+        if is_inj:
+            if not ctx["answering"]:
+                ok = False
+        else:
+            try:
+                probe(dev)
+            except Exception:
+                ok = False
+        if not ok:
+            break
+    ctx["healthy"] = ctx["healthy"] + 1 if ok else 0
+    ordinals = sorted(_device_ordinal(d) for d, _ in ctx["dead"])
+    olog.event("device_probe", outcome="answering" if ok else "out",
+               devices=ordinals, healthy_streak=ctx["healthy"],
+               needed=ctx["k"], probe=ctx["probes"])
+    if ok and ctx["healthy"] == 1:
+        log(f"elastic: out-of-service ordinals {ordinals} answering "
+            f"(streak 1/{ctx['k']})")
+    return ctx["healthy"] >= ctx["k"]
+
+
+def recover_grow(model, sig: DeviceReturnDetected, ctx: Dict, rebuild,
+                 olog=None, log=print):
+    """Full re-expansion for one detected device return — the inverse of
+    :func:`recover`.  Grows the machine back (``MachineModel.grow``),
+    re-searches warm-started from the cached PRE-SHRINK strategy (the
+    running shrunk strategy is the per-op fallback), and migrates the
+    live state in memory (grow only fires at healthy boundaries, so the
+    state is always reachable; a migration failure raises and the caller
+    keeps training shrunk — growing is an optimization, never worth
+    killing a healthy run over).
+
+    Returns ``(new_model, carry, prior_losses)`` like :func:`recover`,
+    and emits exactly ONE ``elastic_resize`` record with ``direction:
+    "grow"`` (plus the ``device_return`` detection record)."""
+    import copy
+
+    import jax
+
+    from flexflow_tpu import obs
+
+    olog = olog if olog is not None else obs.NULL
+    t0 = time.perf_counter()
+    cfg = model.config
+    n_old = model.machine.num_devices
+    returned_devs = [dev for dev, _ in ctx["dead"]]
+    ordinals = sorted(_device_ordinal(d) for d in returned_devs)
+    new_machine = model.machine.grow(returned_devs)
+    n_new = new_machine.num_devices
+    olog.event("device_return", step=sig.step, returned=ordinals,
+               from_devices=n_old, to_devices=n_new,
+               probes=ctx.get("probes"), healthy_streak=ctx.get("healthy"))
+    log(f"elastic: ordinals {ordinals} back after {ctx.get('probes')} "
+        f"probe(s) — growing {n_old} -> {n_new} devices at iteration "
+        f"{sig.step}")
+    if rebuild is None:
+        raise DeviceLostError(
+            "elastic regrow needs a model factory: pass "
+            "rebuild=lambda cfg, machine: <build model> to fit() "
+            "(the drivers do)")
+
+    try:
+        prior = [float(v) for v in jax.device_get(list(sig.losses))]
+    except Exception:
+        prior = []
+
+    t_search = time.perf_counter()
+    strategy, research = research_strategy(
+        cfg, rebuild, new_machine, ctx.get("pre_strategy"),
+        olog=olog, log=log,
+        fallback_strategy=getattr(cfg, "strategies", None))
+    research_s = time.perf_counter() - t_search
+
+    final_cfg = copy.copy(cfg)
+    final_cfg.strategies = strategy
+    new_model = rebuild(final_cfg, new_machine)
+
+    full_p, full_s, full_o = gather_state(model, sig.params, sig.state,
+                                          sig.opt_state)
+    from flexflow_tpu.parallel.regrid import plan_state_migration
+
+    mig_plan = plan_state_migration(model, new_model, full_p, full_s,
+                                    full_o)
+    params, state, opt_state = new_model.place_state(full_p, full_s,
+                                                     full_o)
+
+    rec = {
+        "step": sig.step, "direction": "grow", "from_devices": n_old,
+        "to_devices": n_new, "returned": ordinals,
+        "research_s": research_s, "research": research,
+        "migration": "in_memory", "resume_step": sig.step,
+        "steps_lost": 0, "total_s": time.perf_counter() - t0,
+        "regrid_bytes": mig_plan["bytes"], "regrid_hops": mig_plan["hops"],
+        "regrid_predicted_s": mig_plan["predicted_s"],
+    }
+    olog.event("elastic_resize", **rec)
+    log(f"elastic: resized {n_old} -> {n_new} devices at iteration "
+        f"{sig.step} (re-search {research_s:.2f}s [{research['mode']}], "
+        f"migration in_memory, resume at {sig.step}, 0 step(s) lost)")
+    carry = {"start_iter": sig.step, "params": params, "state": state,
+             "opt_state": opt_state}
+    return new_model, carry, prior
+
+
+# ---------------------------------------------------------------------------
+# preemption-aware graceful drain
+
+
+def install_drain_handler(drain: Dict, log=print):
+    """Install SIGTERM/SIGINT handlers that set ``drain["requested"]``
+    (read at fit()'s existing boundaries) and return an IDEMPOTENT,
+    re-entrant restore callable — the drain path and the error path can
+    both reach the uninstall.  Installable only from the main thread
+    (``signal.signal`` raises ValueError elsewhere); then, and when the
+    runtime forbids handlers entirely, ``drain["installed"]`` stays
+    False and ``preempt`` injection falls back to setting the flag
+    directly."""
+    import signal
+    import threading
+
+    drain.setdefault("requested", False)
+    drain.setdefault("signum", None)
+    drain["installed"] = False
+
+    def _handler(signum, frame):
+        if not drain["requested"]:
+            drain["requested"] = True
+            drain["signum"] = int(signum)
+            try:
+                name = signal.Signals(signum).name
+            except Exception:
+                name = str(signum)
+            log(f"elastic: {name} received — draining at the next "
+                f"host-sync boundary")
+
+    prev: Dict = {}
+    try:
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            prev[signum] = signal.signal(signum, _handler)
+        drain["installed"] = True
+    except (ValueError, OSError, RuntimeError):
+        # non-main thread (or a runtime that forbids handlers): roll back
+        # whatever half got installed and run flag-only
+        for signum, old in prev.items():
+            try:
+                signal.signal(signum, old)
+            except Exception:
+                pass
+        prev = {}
+
+    done = [False]
+    lock = threading.Lock()
+
+    def restore() -> bool:
+        with lock:
+            if done[0]:
+                return False
+            done[0] = True
+        for signum, old in prev.items():
+            try:
+                signal.signal(signum, old)
+            except Exception:
+                pass
+        return True
+
+    return restore
+
+
+def request_drain(drain: Dict) -> None:
+    """The ``preempt`` injection entry point: raise the REAL signal path
+    when the handler is installed (so the injected fault exercises the
+    exact production code), else set the flag directly."""
+    import signal
+
+    if drain.get("installed"):
+        signal.raise_signal(signal.SIGTERM)
+    else:
+        drain["requested"] = True
+        drain["signum"] = int(signal.SIGTERM)

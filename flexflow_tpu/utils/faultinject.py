@@ -46,7 +46,24 @@ Kinds:
                         this whole process dying mid-run (exercises the
                         error-exit cleanup — coordinator release,
                         prefetcher shutdown — and the ``--elastic``
-                        restart/rejoin protocol in distributed.py).
+                        restart/rejoin protocol in distributed.py);
+  * ``device_return`` — counted per elastic REGROW PROBE (the boundary
+                        probe of previously-dead ordinals after a
+                        shrink): on fire, the injected-dead devices
+                        answer again, so after ``--regrow-probes``
+                        consecutive healthy probes the run grows back
+                        (``recover_grow``, utils/elastic.py);
+  * ``preempt``       — counted per training step: raises the graceful-
+                        drain signal path (the same SIGTERM handler fit
+                        installs), so the run finishes the in-flight
+                        step, commits a final verified checkpoint and
+                        exits 0 within ``--drain-budget-s``;
+  * ``step_hang``     — counted per training step: deterministically
+                        stalls the NEXT host-sync boundary past the step
+                        watchdog's deadline (``--hang-factor``,
+                        utils/health.StepWatchdog), converting a wedged
+                        collective into the probe/classify recovery
+                        path.
 
 One injector is installed process-globally (``install``/``get``) so data
 sources running on background threads see the same schedule; ``fit()``
@@ -61,7 +78,8 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 KINDS = ("loss_nan", "data_io", "ckpt_truncate", "ckpt_corrupt",
-         "device_loss", "host_crash")
+         "device_loss", "host_crash", "device_return", "preempt",
+         "step_hang")
 
 
 class FaultSpecError(ValueError):
@@ -179,6 +197,26 @@ def install(injector):
         prev = _current
         _current = injector if injector is not None else NULL
         return prev
+
+
+def install_scoped(injector):
+    """Install ``injector`` and return an IDEMPOTENT, re-entrant restore
+    callable.  fit()'s graceful-drain path and its error path can both
+    reach the uninstall; a second (or concurrent) call must be a no-op
+    instead of clobbering whatever a later run installed."""
+    prev = install(injector)
+    done = [False]
+    lock = threading.Lock()
+
+    def restore() -> bool:
+        with lock:
+            if done[0]:
+                return False
+            done[0] = True
+        install(prev)
+        return True
+
+    return restore
 
 
 def from_config(config, olog=None):

@@ -24,6 +24,21 @@ Policies (``FFConfig.on_divergence``):
 All detections flow through obs as first-class ``fault`` records
 (source="guard"); the first clean window after a rollback emits the
 matching ``recovery`` record.
+
+Round 9 adds :class:`StepWatchdog` for the failure mode the guard cannot
+see: a WEDGED collective.  Device loss that raises is classified by
+utils/elastic.py, but a hang never raises — the blocking ``device_get``
+at a boundary just sits there forever.  The watchdog arms a one-shot
+timer around exactly those blocking windows (zero per-step cost; off by
+default via ``--hang-factor 0``) with a deadline of ``hang_factor`` × a
+robust rolling per-step time estimate (median of recent boundaries,
+floored at ``--hang-min-s``).  On expiry it emits a ``step_hang`` fault
+record from the timer thread; the MAIN thread — once whatever was wedged
+finally returns or the injected stall ends — sees the expiry at
+``disarm()`` and routes into the existing probe/classify path
+(transient -> keep training, permanent -> ``DeviceLossDetected`` ->
+shrink).  The injected ``step_hang@N`` stalls inside an armed window
+deterministically (``stall()``) so CI drives the full path.
 """
 
 from __future__ import annotations
@@ -118,3 +133,104 @@ class StepHealthGuard:
             self._await_recovery = True
             return "rollback"
         raise TrainingDiverged(step, value)
+
+
+class StepWatchdog:
+    """Hang detector armed around fit()'s blocking host-sync windows.
+
+    One instance per ``fit()`` call.  Lifecycle per boundary::
+
+        wd.observe(wall_s, steps)   # feed the rolling step-time estimate
+        wd.arm(step)                # start the one-shot deadline timer
+        ... blocking device_get / checkpoint sync ...
+        info = wd.disarm()          # cancel (or collect the expiry)
+        if info: <probe/classify>   # main thread routes the recovery
+
+    The timer thread only SETS state and emits the ``step_hang`` obs
+    record (the obs sink is already thread-safe — the fault injector
+    fires from data threads); all recovery decisions stay on the main
+    thread.  ``close()`` is idempotent and joins any live timer so the
+    thread-leak checks stay clean."""
+
+    def __init__(self, factor: float, min_deadline_s: float = 60.0,
+                 window: int = 32, olog=None, log=print):
+        from flexflow_tpu import obs
+
+        self.factor = float(factor)
+        self.min_deadline_s = float(min_deadline_s)
+        self.window = max(int(window), 1)
+        self.olog = olog if olog is not None else obs.NULL
+        self.log = log
+        self.enabled = self.factor > 0
+        self._estimates: list = []
+        self._timer = None
+        self._expired = None
+        self._step = None
+        self.hangs = 0
+
+    def observe(self, wall_s: float, steps: int = 1) -> None:
+        """Feed one inter-boundary wall time covering ``steps`` steps."""
+        if steps <= 0 or wall_s <= 0:
+            return
+        self._estimates.append(float(wall_s) / steps)
+        del self._estimates[:-self.window]
+
+    def step_estimate_s(self) -> float:
+        """Robust (median) per-step wall estimate; 0 until observed."""
+        if not self._estimates:
+            return 0.0
+        vals = sorted(self._estimates)
+        return vals[len(vals) // 2]
+
+    def deadline_s(self) -> float:
+        return max(self.factor * self.step_estimate_s(),
+                   self.min_deadline_s)
+
+    def _expire(self, step: int, deadline: float) -> None:
+        self._expired = {"step": step, "deadline_s": deadline,
+                         "estimate_s": self.step_estimate_s()}
+        self.hangs += 1
+        self.olog.event("step_hang", step=step, deadline_s=deadline,
+                        estimate_s=self._expired["estimate_s"],
+                        factor=self.factor)
+        self.log(f"watchdog: boundary at iteration {step} exceeded its "
+                 f"{deadline:.1f}s deadline — probing devices when it "
+                 f"returns")
+
+    def arm(self, step: int) -> None:
+        """Start the one-shot deadline timer for this boundary."""
+        if not self.enabled:
+            return
+        import threading
+
+        self.disarm()
+        self._expired = None
+        self._step = int(step)
+        deadline = self.deadline_s()
+        self._timer = threading.Timer(
+            deadline, self._expire, args=(self._step, deadline))
+        self._timer.daemon = True
+        self._timer.name = f"ff-step-watchdog-{self._step}"
+        self._timer.start()
+
+    def disarm(self):
+        """Cancel the timer (joining it so no thread outlives the call)
+        and return the expiry info dict if the deadline fired, else
+        None."""
+        t, self._timer = self._timer, None
+        if t is not None:
+            t.cancel()
+            t.join(timeout=5.0)
+        info, self._expired = self._expired, None
+        return info
+
+    def stall(self, margin_s: float = 0.25, sleep=None) -> None:
+        """The injected ``step_hang`` wedge: block inside the armed
+        window until just past the deadline, deterministically forcing
+        an expiry without any real hardware misbehaving."""
+        import time as _time
+
+        (sleep or _time.sleep)(self.deadline_s() + margin_s)
+
+    def close(self) -> None:
+        self.disarm()

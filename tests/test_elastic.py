@@ -375,7 +375,7 @@ def test_report_renders_elastic_records(machine8, tmp_path):
     events = list(obs.read_run(out["obs_path"]))
     text = render(events)
     assert "== elastic ==" in text
-    assert "elastic_resize: 8 -> 6" in text
+    assert "elastic_resize[shrink]: 8 -> 6" in text
     assert "async checkpoints:" in text
     s = summarize(events)
     assert s["elastic"]["counts"]["elastic_resize"] == 1

@@ -1,0 +1,85 @@
+"""Fault-kind consistency check — wired into ``make check``.
+
+Every injectable fault kind declared in ``utils/faultinject.py`` must
+be (1) documented in README.md's fault-injection table and (2)
+exercised by at least one test under ``tests/``.  A kind someone adds
+to KINDS without docs or coverage fails the build here, not in review.
+
+Pure text analysis — KINDS is regex-extracted from the module SOURCE,
+so the check needs no jax and runs anywhere (including the native-only
+``make check`` environment).
+
+    python tools/check_fault_kinds.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+
+def declared_kinds(root: str) -> list:
+    src = open(os.path.join(root, "flexflow_tpu", "utils",
+                            "faultinject.py")).read()
+    m = re.search(r"^KINDS\s*=\s*\(([^)]*)\)", src, re.M | re.S)
+    if not m:
+        raise SystemExit("check_fault_kinds: no KINDS tuple in "
+                         "flexflow_tpu/utils/faultinject.py")
+    kinds = re.findall(r"[\"']([a-z_]+)[\"']", m.group(1))
+    if not kinds:
+        raise SystemExit("check_fault_kinds: KINDS tuple parsed empty")
+    return kinds
+
+
+def readme_kinds(root: str) -> set:
+    """Kinds documented as fault-table rows: ``| `kind` | ...``."""
+    out = set()
+    for line in open(os.path.join(root, "README.md")):
+        m = re.match(r"\|\s*`([a-z_]+)`\s*\|", line)
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def tested_kinds(root: str, kinds: list) -> dict:
+    """kind -> list of test files whose text references it."""
+    hits = {k: [] for k in kinds}
+    tdir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".py"):
+            continue
+        text = open(os.path.join(tdir, name)).read()
+        for k in kinds:
+            if k in text:
+                hits[k].append(name)
+    return hits
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    root = argv[0] if argv else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    kinds = declared_kinds(root)
+    in_readme = readme_kinds(root)
+    in_tests = tested_kinds(root, kinds)
+    problems = []
+    for k in kinds:
+        if k not in in_readme:
+            problems.append(f"kind {k!r} missing from the README.md "
+                            f"fault-injection table")
+        if not in_tests[k]:
+            problems.append(f"kind {k!r} not referenced by any test "
+                            f"under tests/")
+    if problems:
+        for p in problems:
+            print(f"check_fault_kinds: FAIL: {p}")
+        return 1
+    print(f"check_fault_kinds ok: {len(kinds)} kinds "
+          f"({', '.join(kinds)}) all documented in README.md and "
+          f"covered by tests/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
