@@ -10,9 +10,12 @@ native:
 
 # native build + ctypes smoke of ffsim_simulate, plus repo consistency:
 # every injectable fault kind must be documented in README.md's fault
-# table and covered by at least one test (tools/check_fault_kinds.py)
+# table and covered by at least one test (tools/check_fault_kinds.py),
+# and every FFConfig CLI flag must be accepted by the LM/NMT parsers and
+# forwarded through their model configs (tools/check_flag_forwarding.py)
 check:
 	$(PYTHON) tools/check_fault_kinds.py
+	$(PYTHON) tools/check_flag_forwarding.py
 	$(MAKE) -C flexflow_tpu/native check
 
 # build libffsim.so and assert ffsim_simulate_trace produces a parseable
@@ -26,15 +29,22 @@ test:
 
 # tiny-config bench on the local backend asserting the metric line
 # carries the round-6 execution-performance fields (regrid planner hop
-# count + prefetch stall residual) — schema smoke, not a perf number
+# count + prefetch stall residual) and the mixed-precision round's
+# policy fields (param_dtype / placed_overlap / mfu_delta_vs_r05) —
+# schema smoke, not a perf number
 bench-smoke:
 	BENCH_MODEL=alexnet BENCH_BATCH=16 BENCH_ITERS=2 BENCH_WARMUP=1 \
-	BENCH_WINDOWS=1 BENCH_DTYPE=float32 $(PYTHON) bench.py \
+	BENCH_WINDOWS=1 BENCH_DTYPE=float32 BENCH_PARAM_DTYPE=bfloat16 \
+	$(PYTHON) bench.py \
 	| $(PYTHON) -c "import json,sys; rec=json.loads(sys.stdin.readline()); \
 	assert 'regrid_hops' in rec and 'input_stall_s' in rec, rec; \
 	assert 'comm_frac' in rec and 'stall_frac' in rec, rec; \
+	assert rec['param_dtype'] == 'bfloat16', rec; \
+	assert rec['placed_overlap'] == 'on', rec; \
+	assert 'mfu_delta_vs_r05' in rec, rec; \
 	print('bench-smoke ok:', {k: rec[k] for k in \
-	('value','regrid_hops','input_stall_s','comm_frac','stall_frac')})"
+	('value','regrid_hops','input_stall_s','comm_frac','stall_frac', \
+	'param_dtype','placed_overlap','mfu_delta_vs_r05')})"
 
 # deterministic fault-injection smoke (robustness round): loss_nan +
 # data_io injected into a tiny HDF5-fed run with --on-divergence

@@ -30,7 +30,7 @@ import time
 
 def run(model="inception", batch_size=None, iters=10, warmup=3,
         dtype="bfloat16", strategy_file=None, compile_cache=False,
-        windows=5):
+        windows=5, param_dtype="float32", placed_overlap="on"):
     """Returns (per_chip, tput, elapsed, mfu, spread, extras) — ``extras``
     carries the execution-performance gauges the round-6 prongs add:
     ``input_stall_s`` (prefetch residual over the timed windows) and the
@@ -65,6 +65,7 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
     machine = MachineModel()
     cfg = FFConfig(batch_size=batch_size, input_height=size, input_width=size,
                    num_iterations=iters, print_freq=0, compute_dtype=dtype,
+                   param_dtype=param_dtype, placed_overlap=placed_overlap,
                    strategy_file=strategy_file or "")
     ff = build(cfg, machine)
     params, state = ff.init()
@@ -212,7 +213,9 @@ def _bench_record():
                            ("BENCH_ITERS", "iters", int),
                            ("BENCH_WARMUP", "warmup", int),
                            ("BENCH_WINDOWS", "windows", int),
-                           ("BENCH_DTYPE", "dtype", str)):
+                           ("BENCH_DTYPE", "dtype", str),
+                           ("BENCH_PARAM_DTYPE", "param_dtype", str),
+                           ("BENCH_PLACED_OVERLAP", "placed_overlap", str)):
         if os.environ.get(env):
             knobs[key] = cast(os.environ[env])
     per_chip, tput, elapsed, mfu, spread, extras = run(
@@ -234,8 +237,24 @@ def _bench_record():
         "spread": spread,
     }
     out.update(extras)
+    # mixed-precision round: which precision/overlap policy this record
+    # measured rides the metric line (runs are only comparable within a
+    # policy), plus the MFU delta against the committed round-5 flagship
+    # record — the waterfall's "did the levers move the headline" gauge
+    out["param_dtype"] = knobs.get("param_dtype", "float32")
+    out["placed_overlap"] = knobs.get("placed_overlap", "on")
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    out["mfu_delta_vs_r05"] = None
+    try:
+        with open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_r05.json")) as f:
+            r05_mfu = json.load(f)["parsed"]["mfu"]
+        if mfu is not None:
+            out["mfu_delta_vs_r05"] = round(mfu - r05_mfu, 4)
+    except Exception as e:
+        print(f"mfu_delta_vs_r05 unavailable: {e}", file=sys.stderr)
     # the benched strategy's simulated timeline, when the search exported
     # one next to the artifact (apps/search.py -trace writes
     # <stem>.trace.json): its path rides the metric line so the harness

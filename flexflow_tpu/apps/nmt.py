@@ -52,6 +52,8 @@ def parse_args(argv) -> RnnConfig:
             cfg.learning_rate = float(val())
         elif a == "--dtype":
             cfg.compute_dtype = val()
+        elif a in ("-param-dtype", "--param-dtype"):
+            cfg.param_dtype = val()
         elif a == "--seed":
             cfg.seed = int(val())
         elif a == "--strategy":
@@ -76,6 +78,8 @@ def parse_args(argv) -> RnnConfig:
             cfg.regrid_planner = val()
         elif a in ("-prefetch-depth", "--prefetch-depth"):
             cfg.prefetch_depth = int(val())
+        elif a in ("-placed-overlap", "--placed-overlap"):
+            cfg.placed_overlap = val()
         elif a == "--ckpt-dir":
             cfg.ckpt_dir = val()
         elif a == "--ckpt-freq":

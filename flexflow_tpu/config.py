@@ -60,6 +60,15 @@ class FFConfig:
                                    # build+partition+compile, execute nothing
     # TPU-native additions
     compute_dtype: str = "float32"   # "bfloat16" for MXU-friendly training
+    # mixed-precision policy (perf round): param_dtype is the STORAGE
+    # dtype of the parameters ("bfloat16" halves parameter/gradient HBM
+    # and collective traffic).  Anything other than float32 switches
+    # the optimizer to master-weight mode: a float32 master copy of
+    # every parameter lives in the optimizer state, the update runs in
+    # float32 against the masters, and the stored params are re-cast
+    # from the masters on write-back (checkpoints carry the masters, so
+    # resume is bit-exact).  Compute dtype stays an independent knob —
+    # the step casts params to compute_dtype before the forward pass.
     param_dtype: str = "float32"
     seed: int = 0
     num_classes: int = 1000
@@ -98,6 +107,14 @@ class FFConfig:
     # cost-aware hop selection; "off" keeps the legacy per-trace path
     # (loss-bit-identical — the equivalence tests compare the two).
     regrid_planner: str = "on"
+    # heterogeneous placed-op overlap (perf round): "on" (default) fuses
+    # independent same-level placed ops that legacy scheduling would
+    # dispatch as SEQUENTIAL shard_maps into one grouped dispatch whose
+    # body branches on the group axis, so XLA runs the disjoint device
+    # blocks concurrently; "off" keeps the legacy one-dispatch-per-op
+    # path (loss-bit-identical — the equivalence tests compare the two,
+    # mirroring the regrid-planner pattern above).
+    placed_overlap: str = "on"
     # double-buffered device prefetch (data/prefetch.py): queue depth of
     # batches staged on device ahead of the training loop; 0 disables
     # (the legacy synchronous pull inside the timed loop)
@@ -205,6 +222,8 @@ class FFConfig:
                 cfg.num_iterations = int(val())
             elif a == "--dtype":
                 cfg.compute_dtype = val()
+            elif a in ("-param-dtype", "--param-dtype"):
+                cfg.param_dtype = val()
             elif a == "--seed":
                 cfg.seed = int(val())
             elif a == "--profiling":
@@ -227,6 +246,8 @@ class FFConfig:
                 cfg.search_delta = val()
             elif a in ("-regrid-planner", "--regrid-planner"):
                 cfg.regrid_planner = val()
+            elif a in ("-placed-overlap", "--placed-overlap"):
+                cfg.placed_overlap = val()
             elif a in ("-prefetch-depth", "--prefetch-depth"):
                 cfg.prefetch_depth = int(val())
             elif a in ("-on-divergence", "--on-divergence"):

@@ -39,6 +39,18 @@ from flexflow_tpu.ops.pool import POOL_MAX
 from flexflow_tpu.strategy import ParallelConfig, validate_strategy
 from flexflow_tpu.utils.debug import print_tensor
 
+# optimizer-state leaf-name suffix of the float32 master weights in
+# mixed-precision (param_dtype != float32) training — the checkpoint
+# format and place_state both key off it (utils/checkpoint.py strips the
+# same literal when mapping a master back to its base leaf's sharding)
+_MASTER_SUFFIX = "__master"
+
+
+def _opt_leaf_base(k: str) -> str:
+    """Base param leaf name of an optimizer-state leaf (identity for
+    momentum buffers, strips the master suffix)."""
+    return k[:-len(_MASTER_SUFFIX)] if k.endswith(_MASTER_SUFFIX) else k
+
 
 def _point_shape(shape, spec, sizes):
     """Shape of one grid point's slice of a ``shape``-d leaf under a
@@ -61,7 +73,9 @@ def _point_rows(tree, reg):
     sizes = dict(zip(reg["axes"], reg["dims"]))
     out = {}
     for k, v in tree.items():
-        spec = reg["specs"][k]
+        # optimizer master leaves reuse their base param leaf's spec
+        spec = reg["specs"][k] if k in reg["specs"] \
+            else reg["specs"][_opt_leaf_base(k)]
         pshape = _point_shape(tuple(v.shape), spec, sizes)
         arr = jnp.zeros((reg["N"],) + pshape, v.dtype)
         for j, dev in enumerate(reg["row"]):
@@ -355,6 +369,10 @@ class FFModel:
                         # PARAMETER_ALL_ONES parity (conv_2d.cu:393-398):
                         # deterministic all-ones weights, hand-checkable runs
                         p = {k: jnp.ones_like(v) for k, v in p.items()}
+                # mixed precision: params are STORED in param_dtype; the
+                # cast lands before placement so every storage family
+                # (set rows / block stacks / plain) sizes off the cast
+                p = self._cast_param_tree(p)
                 bp = getattr(self, "_block_params", {}).get(op.param_key)
                 if p and bp and bp.get("family") == "set":
                     # set-family residency (round 5): per-device POINT
@@ -442,10 +460,85 @@ class FFModel:
                         lambda v: jax.device_put(v, repl), s)
         return params, state
 
+    # ------------------------------------------------------------------
+    # mixed precision (perf round): param_dtype != float32 stores the
+    # parameters low-precision (halved HBM/collective traffic) while a
+    # float32 MASTER copy of every float leaf rides in the optimizer
+    # state under ``<leaf>__master`` — update math runs in float32
+    # against the masters and the stored params are re-cast from them on
+    # write-back.  The opt tree stays exactly two levels deep
+    # ({param_key: {leaf: array}}), which checkpointing and place_state
+    # assume; master leaves map to their base leaf's sharding.
+
+    def _mixed_precision(self) -> bool:
+        return (getattr(self.config, "param_dtype", "float32")
+                or "float32") != "float32"
+
+    def _cast_param_tree(self, p):
+        """Cast a freshly initialized param tree to the configured
+        storage dtype — float leaves only; works on concrete arrays and
+        the abstract (ShapeDtypeStruct) traversal alike."""
+        import jax
+        import jax.numpy as jnp
+
+        if not p or not self._mixed_precision():
+            return p
+        dt = jnp.dtype(self.config.param_dtype)
+
+        def cast(v):
+            if not jnp.issubdtype(v.dtype, jnp.floating):
+                return v
+            if isinstance(v, jax.ShapeDtypeStruct):
+                return jax.ShapeDtypeStruct(v.shape, dt,
+                                            sharding=v.sharding)
+            return v.astype(dt)
+
+        return {k: cast(v) for k, v in p.items()}
+
+    def master_opt_state(self, params):
+        """The master-weight half of the optimizer state: a float32
+        master per float param leaf (``<leaf>__master``), initialized as
+        the upcast of the stored params (exact for a fresh bfloat16
+        init — the cast that produced the stored copy is recovered
+        losslessly only up to bf16 resolution, so init keeps the
+        invariant params == masters.astype(param_dtype)).  None in plain
+        float32 mode — the plain-SGD subclasses return this directly
+        from init_opt_state."""
+        import jax.numpy as jnp
+
+        if not self._mixed_precision():
+            return None
+        return {key: {k + _MASTER_SUFFIX: v.astype(jnp.float32)
+                      for k, v in sub.items()
+                      if jnp.issubdtype(v.dtype, jnp.floating)}
+                for key, sub in params.items()}
+
     def init_opt_state(self, params):
         import jax
 
-        return jax.tree.map(lambda p: p * 0.0, params)
+        if not self._mixed_precision():
+            return jax.tree.map(lambda p: p * 0.0, params)
+        import jax.numpy as jnp
+
+        out = {}
+        for key, sub in params.items():
+            d = {}
+            for k, v in sub.items():
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    m = v.astype(jnp.float32)
+                    d[k] = m * 0.0          # float32 momentum buffer
+                    d[k + _MASTER_SUFFIX] = m
+                else:
+                    d[k] = v * 0
+            out[key] = d
+        return out
+
+    def _opt_shardings(self, opt_state, psh):
+        """{param_key: {opt leaf: sharding}} mirroring ``opt_state`` —
+        master leaves share their base param leaf's sharding (same
+        shape; shardings are dtype-agnostic)."""
+        return {key: {k: psh[key][_opt_leaf_base(k)] for k in sub}
+                for key, sub in opt_state.items()}
 
     def _param_shardings(self, params):
         """{param_key: {name: sharding}} mirroring ``params`` — the same
@@ -640,8 +733,9 @@ class FFModel:
         from flexflow_tpu.parallel.placement import (PlacementGroup,
                                                      plan_schedule)
 
-        sched = plan_schedule(self.layers, self.machine.num_devices,
-                              exclude=exclude)
+        sched = plan_schedule(
+            self.layers, self.machine.num_devices, exclude=exclude,
+            overlap=getattr(self.config, "placed_overlap", "on") != "off")
         pcs = list(getattr(self, "_honored_pcs", ()))
         for entry in sched:
             if isinstance(entry, PlacementGroup):
@@ -787,9 +881,11 @@ class FFModel:
                 from flexflow_tpu.parallel.placement import _assemble
 
                 sizes = dict(zip(bp["axes"], bp["dims"]))
+                # master leaves (mixed precision) reuse the base spec
                 p = {k: _assemble([l[d] for d in bp["row"]],
-                                  bp["specs"][k], sizes, bp["axes"],
-                                  bp["dims"])
+                                  bp["specs"][k] if k in bp["specs"]
+                                  else bp["specs"][_opt_leaf_base(k)],
+                                  sizes, bp["axes"], bp["dims"])
                      for k, l in p.items()}
             else:
                 p = jax.tree.map(lambda l: l[bp["slot"]], p)
@@ -849,10 +945,16 @@ class FFModel:
         block = getattr(self, "_block_params", {})
         block_state = getattr(self, "_block_state", {})
 
+        def shard_of(sh, k):
+            # optimizer master leaves (<leaf>__master) inherit the BASE
+            # param leaf's sharding — shardings are dtype-agnostic
+            return sh[k] if k in sh else sh[_opt_leaf_base(k)]
+
         def stack(tree, slot, G, sh):
             return {k: jax.device_put(
                 jnp.zeros((G,) + tuple(np.shape(v)),
-                          np.asarray(v).dtype).at[slot].set(v), sh[k])
+                          np.asarray(v).dtype).at[slot].set(v),
+                shard_of(sh, k))
                 for k, v in tree.items()}
 
         def place_keyed(tree):
@@ -865,7 +967,7 @@ class FFModel:
                 bp = block.get(key)
                 if p and bp and bp.get("family") == "set":
                     sh = self._block_sharding(bp)
-                    out[key] = {k: jax.device_put(v, sh[k])
+                    out[key] = {k: jax.device_put(v, shard_of(sh, k))
                                 for k, v in _point_rows(p, bp).items()}
                 elif p and bp:
                     out[key] = stack(p, bp["slot"], bp["G"],
@@ -873,9 +975,11 @@ class FFModel:
                 elif p:
                     with self._honored_ctx():
                         sh = op.param_shardings(self.machine)
-                    out[key] = {k: jax.device_put(v, sh[k]) if k in sh
-                                else jax.device_put(v)
-                                for k, v in p.items()}
+                    out[key] = {k: jax.device_put(
+                        v, sh.get(k, sh.get(_opt_leaf_base(k))))
+                        if (k in sh or _opt_leaf_base(k) in sh)
+                        else jax.device_put(v)
+                        for k, v in p.items()}
             return out
 
         placed_p = place_keyed(params)
@@ -1173,6 +1277,8 @@ class FFModel:
         cfg = self.config
         lr, wd, mu = cfg.learning_rate, cfg.weight_decay, cfg.momentum
         cdtype = cfg.compute_dtype
+        if self._mixed_precision():
+            return self._make_mixed_train_step(lr, wd, mu, cdtype)
 
         def train_step(params, state, opt_state, image, labels):
             image = image.astype(cdtype)
@@ -1199,11 +1305,62 @@ class FFModel:
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
+    def _make_mixed_train_step(self, lr, wd, mu, cdtype):
+        """Master-weight variant of make_train_step (param_dtype !=
+        float32): the forward/backward runs on compute-dtype casts of
+        the low-precision stored params, the momentum update runs in
+        float32 against the masters in the optimizer state, and the
+        stored params are re-cast from the updated masters — update math
+        never accumulates in the storage dtype."""
+        import jax
+        import jax.numpy as jnp
+
+        def train_step(params, state, opt_state, image, labels):
+            image = image.astype(cdtype)
+
+            def lf(p):
+                pc = jax.tree.map(
+                    lambda v: v.astype(cdtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v, p)
+                return self.loss_fn(pc, state, image, labels, train=True)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            new_params, new_opt = {}, {}
+            for key, sub in params.items():
+                np_, no_, osub = {}, {}, opt_state[key]
+                for k, p in sub.items():
+                    mk = k + _MASTER_SUFFIX
+                    if mk in osub:
+                        g = grads[key][k].astype(jnp.float32)
+                        m, v = osub[mk], osub[k]
+                        v = mu * v + g + wd * m
+                        m = m - lr * v
+                        np_[k] = m.astype(p.dtype)
+                        no_[k], no_[mk] = v, m
+                    else:  # non-float leaf: in-dtype legacy update
+                        v = mu * osub[k] + grads[key][k] + wd * p
+                        np_[k], no_[k] = p - lr * v, v
+                new_params[key], new_opt[key] = np_, no_
+            psh = self._param_shardings(new_params)
+            return (self._constrain_params(new_params, psh),
+                    self._constrain_state(new_state),
+                    self._constrain_params(
+                        new_opt, self._opt_shardings(new_opt, psh)),
+                    loss)
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
     def make_sgd_step(self, lr: float):
         """Plain-SGD train step over ``self.loss_fn(params, state, *batch)``
         — shared by the RNN and transformer subclasses (their reference
-        counterparts apply bare rate*grad updates, nmt/rnn.cu:684-702)."""
+        counterparts apply bare rate*grad updates, nmt/rnn.cu:684-702).
+        In mixed-precision mode the opt_state carries the float32 masters
+        (master_opt_state); the rate*grad update runs against them."""
         import jax
+
+        if self._mixed_precision():
+            return self._make_mixed_sgd_step(lr)
 
         def train_step(params, state, opt_state, *batch):
             def lf(p):
@@ -1218,6 +1375,48 @@ class FFModel:
                 opt_state, loss
 
         return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def _make_mixed_sgd_step(self, lr: float):
+        """Master-weight variant of make_sgd_step: float32 rate*grad
+        update against the masters, stored params re-cast from them."""
+        import jax
+        import jax.numpy as jnp
+
+        cdtype = self.config.compute_dtype
+
+        def train_step(params, state, opt_state, *batch):
+            def lf(p):
+                pc = jax.tree.map(
+                    lambda v: v.astype(cdtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v, p)
+                return self.loss_fn(pc, state, *batch, train=True)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            new_params, new_opt = {}, {}
+            for key, sub in params.items():
+                np_, no_ = {}, {}
+                osub = (opt_state or {}).get(key, {})
+                for k, p in sub.items():
+                    mk = k + _MASTER_SUFFIX
+                    if mk in osub:
+                        m = osub[mk] - lr * grads[key][k].astype(
+                            jnp.float32)
+                        np_[k], no_[mk] = m.astype(p.dtype), m
+                    else:
+                        np_[k] = p - lr * grads[key][k]
+                new_params[key] = np_
+                if no_:
+                    new_opt[key] = no_
+            psh = self._param_shardings(new_params)
+            new_params = self._constrain_params(new_params, psh)
+            if new_opt:
+                new_opt = self._constrain_params(
+                    new_opt, self._opt_shardings(new_opt, psh))
+            return new_params, self._constrain_state(new_state), \
+                new_opt or opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     @staticmethod
     def _lower_step(step, params, state, opt_state, batch):
@@ -1245,7 +1444,16 @@ class FFModel:
                                                   sharding=p.sharding),
                 opt_state, params)
         except ValueError:
-            pass
+            # mixed-precision opt trees carry extra __master leaves, so
+            # the structures diverge — map each opt leaf to its BASE
+            # param leaf's sharding instead (masters mirror their param)
+            if isinstance(opt_state, dict):
+                opt_state = {
+                    key: {k: jax.ShapeDtypeStruct(
+                        o.shape, o.dtype,
+                        sharding=params[key][_opt_leaf_base(k)].sharding)
+                        for k, o in sub.items()}
+                    for key, sub in opt_state.items()}
         return params, state, opt_state
 
     def compile_train_step(self, *batch):
@@ -1268,6 +1476,12 @@ class FFModel:
 
         def eval_step(params, state, image, labels):
             image = image.astype(self.config.compute_dtype)
+            if self._mixed_precision():
+                cdtype = self.config.compute_dtype
+                params = jax.tree.map(
+                    lambda v: v.astype(cdtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v,
+                    params)
             inputs = {self._inputs[0].tid: image}
             values, _ = self.apply(params, state, inputs, train=False)
             log_probs = values[loss_op.output.tid]
@@ -2296,7 +2510,14 @@ class FFModel:
                 last_loss = float(losses[-1])
             except (TypeError, ValueError):
                 pass
+        try:  # parameter residency at storage dtype (halves under bf16)
+            param_bytes = float(sum(
+                v.size * v.dtype.itemsize
+                for sub in params.values() for v in sub.values()))
+        except Exception:
+            param_bytes = None
         metrics.update(
+            param_bytes_total=param_bytes,
             throughput_items_per_sec=throughput,
             images_per_sec=throughput,
             mfu=mfu, mfu_ceiling=mfu_ceiling,

@@ -40,6 +40,9 @@ class TransformerConfig:
     learning_rate: float = 1e-3
     num_iterations: int = 10
     compute_dtype: str = "float32"
+    # parameter storage dtype ("bfloat16" = mixed precision with f32
+    # masters in the optimizer state; forwarded to FFConfig)
+    param_dtype: str = "float32"
     seed: int = 0
     # verification mechanisms (forwarded to FFConfig; SURVEY.md §4)
     params_init: str = "default"
@@ -54,6 +57,7 @@ class TransformerConfig:
     # execution performance (forwarded to FFConfig; round 6)
     regrid_planner: str = "on"
     prefetch_depth: int = 2
+    placed_overlap: str = "on"
     # fault tolerance (forwarded to FFConfig; robustness round)
     ckpt_dir: str = ""
     ckpt_freq: int = 0
@@ -89,6 +93,7 @@ class TransformerLM(FFModel):
             weight_decay=0.0,
             num_iterations=self.t.num_iterations,
             compute_dtype=self.t.compute_dtype,
+            param_dtype=self.t.param_dtype,
             seed=self.t.seed,
             params_init=self.t.params_init,
             print_intermediates=self.t.print_intermediates,
@@ -99,6 +104,7 @@ class TransformerLM(FFModel):
             metrics_path=self.t.metrics_path,
             regrid_planner=self.t.regrid_planner,
             prefetch_depth=self.t.prefetch_depth,
+            placed_overlap=self.t.placed_overlap,
             ckpt_dir=self.t.ckpt_dir,
             ckpt_freq=self.t.ckpt_freq,
             on_divergence=self.t.on_divergence,
@@ -184,7 +190,9 @@ class TransformerLM(FFModel):
         return self.make_sgd_step(self.t.learning_rate)
 
     def init_opt_state(self, params):
-        return None  # plain SGD carries no state; skip the momentum buffers
+        # plain SGD carries no momentum buffers; mixed-precision mode
+        # still needs the float32 masters (None in float32 mode)
+        return self.master_opt_state(params)
 
 
 def build_bert_base(machine=None, strategies=None,

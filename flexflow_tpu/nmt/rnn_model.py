@@ -51,6 +51,9 @@ class RnnConfig:
     learning_rate: float = 0.1       # reference applies rate -0.1 updates
     num_iterations: int = 10
     compute_dtype: str = "float32"
+    # parameter storage dtype ("bfloat16" = mixed precision with f32
+    # masters in the optimizer state; forwarded to FFConfig)
+    param_dtype: str = "float32"
     seed: int = 0
     # verification mechanisms (forwarded to FFConfig; SURVEY.md §4)
     params_init: str = "default"
@@ -65,6 +68,7 @@ class RnnConfig:
     # execution performance (forwarded to FFConfig; round 6)
     regrid_planner: str = "on"
     prefetch_depth: int = 2
+    placed_overlap: str = "on"
     # fault tolerance (forwarded to FFConfig; robustness round)
     ckpt_dir: str = ""
     ckpt_freq: int = 0
@@ -160,6 +164,7 @@ class RnnModel(FFModel):
             weight_decay=0.0,
             num_iterations=self.rnn.num_iterations,
             compute_dtype=self.rnn.compute_dtype,
+            param_dtype=self.rnn.param_dtype,
             seed=self.rnn.seed,
             params_init=self.rnn.params_init,
             print_intermediates=self.rnn.print_intermediates,
@@ -170,6 +175,7 @@ class RnnModel(FFModel):
             metrics_path=self.rnn.metrics_path,
             regrid_planner=self.rnn.regrid_planner,
             prefetch_depth=self.rnn.prefetch_depth,
+            placed_overlap=self.rnn.placed_overlap,
             ckpt_dir=self.rnn.ckpt_dir,
             ckpt_freq=self.rnn.ckpt_freq,
             on_divergence=self.rnn.on_divergence,
@@ -280,7 +286,9 @@ class RnnModel(FFModel):
         return self.make_sgd_step(self.rnn.learning_rate)
 
     def init_opt_state(self, params):
-        return None  # plain SGD carries no state; skip the momentum buffers
+        # plain SGD carries no momentum buffers; mixed-precision mode
+        # still needs the float32 masters (None in float32 mode)
+        return self.master_opt_state(params)
 
     def fit(self, data_iter, num_iterations: Optional[int] = None,
             warmup: int = 1, log=print, rebuild=None):

@@ -58,5 +58,24 @@ def maxpool_enabled() -> bool:
         in ("1", "true")
 
 
-__all__ = ["flash_attention", "flash_enabled", "maxpool_enabled",
-           "tpu_compiler_params"]
+def avgpool_enabled() -> bool:
+    """Policy gate for the Pallas avg-pool backward (ops/pallas/avgpool
+    .py — non-overlapping/global geometries only): OFF by default, opt-in
+    FLEXFLOW_TPU_AVGPOOL=1.  An attribution candidate from the MFU
+    waterfall's per-op residue pending an end-to-end TPU measurement —
+    the maxpool experience (per-op 2x, end-to-end jitter-band) sets the
+    evidence bar for flipping a kernel default."""
+    return os.environ.get("FLEXFLOW_TPU_AVGPOOL", "").lower() \
+        in ("1", "true")
+
+
+def bnrelu_enabled() -> bool:
+    """Policy gate for the fused batchnorm-normalize+ReLU kernel pair
+    (ops/pallas/bn_act.py): OFF by default, opt-in FLEXFLOW_TPU_BNRELU=1.
+    Same pending-measurement status as avgpool_enabled."""
+    return os.environ.get("FLEXFLOW_TPU_BNRELU", "").lower() \
+        in ("1", "true")
+
+
+__all__ = ["avgpool_enabled", "bnrelu_enabled", "flash_attention",
+           "flash_enabled", "maxpool_enabled", "tpu_compiler_params"]

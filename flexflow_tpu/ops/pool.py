@@ -210,18 +210,30 @@ class Pool2D(Op):
         pools (and multi-device grids) keep the XLA path: measured on the
         compiled Inception step, XLA's fwd reduce_window there rides
         producer fusions for ~free, which a standalone kernel pass cannot
-        beat (see the maxpool module docstring)."""
+        beat (see the maxpool module docstring).
+
+        AVG pools with exactly-tiling windows (stride == kernel, or the
+        global pool) route through ops/pallas/avgpool.py under their own
+        gate — there the backward is a pure block upsample of dy."""
+        if len(self.pc.devices) > 1 or any(d != 1 for d in self.pc.dims):
+            return False
+        _, h, w, _ = self.inputs[0].shape
+        if self.pool_type == POOL_AVG:
+            from flexflow_tpu.ops.pallas import avgpool_enabled
+            from flexflow_tpu.ops.pallas.avgpool import supported as avg_ok
+
+            return (avgpool_enabled()
+                    and avg_ok(self.kernel_h, self.kernel_w, self.stride_h,
+                               self.stride_w, self.padding_h, self.padding_w,
+                               h, w))
         from flexflow_tpu.ops.pallas import maxpool_enabled
         from flexflow_tpu.ops.pallas.maxpool import supported
 
-        _, h, w, _ = self.inputs[0].shape
         return (maxpool_enabled()
                 and supported(self.kernel_h, self.kernel_w, self.stride_h,
                               self.stride_w, self.padding_h, self.padding_w,
                               self.pool_type)
-                and min(h, w) >= 48
-                and len(self.pc.devices) <= 1
-                and all(d == 1 for d in self.pc.dims))
+                and min(h, w) >= 48)
 
     def forward(self, params, state, xs: List, train: bool):
         import jax
@@ -230,6 +242,13 @@ class Pool2D(Op):
 
         (x,) = xs
         if self._use_pallas(x):
+            if self.pool_type == POOL_AVG:
+                from flexflow_tpu.ops.pallas.avgpool import avgpool2d
+
+                return avgpool2d(x, self.kernel_h, self.kernel_w,
+                                 self.stride_h, self.stride_w,
+                                 self.padding_h, self.padding_w,
+                                 relu=self.relu), state
             from flexflow_tpu.ops.pallas.maxpool import maxpool2d
 
             return maxpool2d(x, self.kernel_h, self.kernel_w,

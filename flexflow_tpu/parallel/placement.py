@@ -83,6 +83,13 @@ class PlacementGroup:
     #: specs (round 4 — None means the first member's grid)
     owner_dims: Optional[Tuple[int, ...]] = None
     owner_axes: Optional[Tuple[str, ...]] = None
+    #: placed-op overlap (round 10): per-member LEAF flags — a True
+    #: member's params thread through the hetero runner as group-stacked
+    #: leaf trees with their inner sharding preserved (the homogeneous
+    #: stacking) instead of the block-replicated f32 ravel vector, which
+    #: admits inner-sharded-param ops (e.g. channel-split linears) into
+    #: one fused dispatch.  None means all-vector (legacy).
+    leaf_members: Optional[List[bool]] = None
 
 
 def placement_slot(op: Op, num_devices: int,
@@ -272,6 +279,25 @@ def _hetero_eligible(op: Op) -> bool:
     return all(t.dtype != "int32" for t in op.all_outputs())
 
 
+def _overlap_eligible(op: Op) -> bool:
+    """Can ``op`` join a heterogeneous group as a LEAF member (placed-op
+    overlap, round 10)?  Leaf members' params are carried as
+    group-stacked leaf trees with their inner sharding preserved — the
+    homogeneous stacking — instead of the block-replicated f32 ravel
+    vector, so ``_params_block_replicated`` no longer gates them.  The
+    member must be stateless (state still rides the ravel vector), have
+    full placed specs, and run NATIVE on the group's owner grid (its
+    param specs name its own grid axes — enforced at grouping time)."""
+    if op.init_state():
+        return False
+    if op.param_specs() is None or op.input_specs() is None:
+        return False
+    if op.output_specs() is None or any(s is None
+                                        for s in op.output_specs()):
+        return False
+    return all(t.dtype != "int32" for t in op.all_outputs())
+
+
 def _axis_translation(op: Op, owner_dims, owner_axes):
     """Map each of ``op``'s grid axes to owner mesh axes such that the
     two linearizations (dim 0 fastest) coincide: every nontrivial guest
@@ -372,7 +398,8 @@ def _hetero_compatible(a, b) -> bool:
 
 
 def plan_schedule(layers: Sequence[Op], num_devices: int,
-                  exclude: frozenset = frozenset()):
+                  exclude: frozenset = frozenset(),
+                  overlap: bool = False):
     """Dataflow schedule for ``layers``: a list whose entries are either a
     layer index (execute that op normally) or a :class:`PlacementGroup`
     (execute its members jointly, placed).  ``exclude`` holds layer
@@ -380,7 +407,17 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
     plan).  Placed ops out of original order are legal because scheduling
     is by dependencies, like the reference's Legion task graph — grouping
     independent ops can never create a cycle (a path between group members
-    would make one an ancestor of the other, which grouping forbids)."""
+    would make one an ancestor of the other, which grouping forbids).
+
+    ``overlap`` (round 10, ``FFConfig.placed_overlap``) additionally
+    admits ops failing only ``_params_block_replicated`` into mixed
+    groups as LEAF members (see :func:`_overlap_eligible`): independent
+    same-level placed ops with inner-sharded params — e.g. two
+    channel-split linears on disjoint blocks — fuse into ONE grouped
+    dispatch instead of serializing as sequential shard_maps.  A group
+    holding a leaf member has its owner grid PINNED (leaf param specs
+    name the member's own grid axes, so owner switches would orphan
+    them); False keeps the legacy grouping exactly."""
     n = len(layers)
     prod_idx: Dict[int, int] = {}
     for i, op in enumerate(layers):
@@ -417,10 +454,12 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
             return any(gs & set(s) for s in slots)
         return g in slots
 
-    def join(grp, i, g, elig):
+    def join(grp, i, g, elig, leaf=False):
         grp["indices"].append(i)
         grp["slots"].append(g)
-        grp["hetero_ok"] = grp["hetero_ok"] and elig
+        grp["leaf"].append(leaf)
+        grp["hetero_ok"] = grp["hetero_ok"] and (elig or leaf)
+        grp["pinned"] = grp["pinned"] or leaf
         group_of[i] = grp["id"]
 
     def group_fits(member_ids, owner_dims, owner_axes):
@@ -443,6 +482,10 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         # set-family groups are homogeneous-only: their per-device switch
         # slices operands by ONE shared spec set
         elig = fam != "set" and _hetero_eligible(op)
+        # placed-op overlap (round 10): a vector-ineligible op may still
+        # join mixed groups as a LEAF member when the knob is on
+        oelig = (overlap and fam != "set" and not elig
+                 and _overlap_eligible(op))
         placed = False
         for grp in open_by_sig.get(sig, []):
             if grp["family"] != fam or conflicts(fam, g, grp["slots"]):
@@ -455,37 +498,60 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
                 # hetero members arrived since and the candidate does not
                 # fit the (possibly switched) owner grid
                 continue
-            join(grp, i, g, elig)
+            if grp["mixed"] and oelig and (
+                    tuple(grp["owner_dims"]) != op.pc.dims
+                    or tuple(grp["owner_axes"]) != op.AXIS_NAMES):
+                continue  # leaf members must run native on the owner
+            join(grp, i, g, elig, oelig)
             placed = True
             break
-        if not placed and elig:
+        if not placed and (elig or oelig):
             for grp in open_by_grid.get((op.pc.num_parts, fam), []):
                 if not grp["hetero_ok"] or conflicts(fam, g, grp["slots"]):
                     continue
                 if any(m in anc[i] for m in grp["indices"]):
                     continue
-                # candidate on the group's current owner grid ...
-                owner = (grp["owner_dims"], grp["owner_axes"])
-                if not group_fits(grp["indices"] + [i], *owner):
-                    # ... or the candidate's grid becomes the owner (it
-                    # may refine the current one, e.g. a spatial conv
-                    # joining batch-grid guests — round 4)
-                    owner = (op.pc.dims, op.AXIS_NAMES)
+                if oelig:
+                    # leaf candidate: native on the current owner, or the
+                    # owner repins to its grid (only while no other leaf
+                    # member has pinned it)
+                    native = (tuple(grp["owner_dims"]) == op.pc.dims
+                              and tuple(grp["owner_axes"])
+                              == op.AXIS_NAMES)
+                    owner = (grp["owner_dims"], grp["owner_axes"])
+                    if not native:
+                        if grp["pinned"]:
+                            continue
+                        owner = (op.pc.dims, op.AXIS_NAMES)
                     if not group_fits(grp["indices"] + [i], *owner):
                         continue
+                else:
+                    # candidate on the group's current owner grid ...
+                    owner = (grp["owner_dims"], grp["owner_axes"])
+                    if not group_fits(grp["indices"] + [i], *owner):
+                        # ... or the candidate's grid becomes the owner
+                        # (it may refine the current one, e.g. a spatial
+                        # conv joining batch-grid guests — round 4),
+                        # unless a leaf member pinned it
+                        if grp["pinned"]:
+                            continue
+                        owner = (op.pc.dims, op.AXIS_NAMES)
+                        if not group_fits(grp["indices"] + [i], *owner):
+                            continue
                 grp["owner_dims"], grp["owner_axes"] = owner
-                join(grp, i, g, elig)
+                join(grp, i, g, elig, oelig)
                 grp["mixed"] = True
                 placed = True
                 break
         if not placed:
             grp = {"id": len(groups), "indices": [i], "slots": [g],
-                   "subset": op.pc.num_parts, "hetero_ok": elig,
-                   "family": fam, "mixed": False,
+                   "subset": op.pc.num_parts, "hetero_ok": elig or oelig,
+                   "family": fam, "mixed": False, "leaf": [oelig],
+                   "pinned": oelig,
                    "owner_dims": op.pc.dims, "owner_axes": op.AXIS_NAMES}
             groups.append(grp)
             open_by_sig.setdefault(sig, []).append(grp)
-            if elig:
+            if elig or oelig:
                 open_by_grid.setdefault(
                     (op.pc.num_parts, fam), []).append(grp)
             group_of[i] = grp["id"]
@@ -554,7 +620,8 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
                     strided=grp["family"] == "stride",
                     device_rows=(list(grp["slots"]) if is_set else None),
                     owner_dims=grp["owner_dims"],
-                    owner_axes=grp["owner_axes"]))
+                    owner_axes=grp["owner_axes"],
+                    leaf_members=list(grp["leaf"])))
             for s in nsucc[nid]:
                 indeg[s] -= 1
                 if indeg[s] == 0:
@@ -570,12 +637,14 @@ def plan_schedule(layers: Sequence[Op], num_devices: int,
         assert split is not None, "cycle without a splittable group"
         last = groups[split]["indices"].pop()
         groups[split]["slots"].pop()
+        was_leaf = groups[split]["leaf"].pop()
+        groups[split]["pinned"] = any(groups[split]["leaf"])
         fam_last, slot_last = placement_slot(layers[last], num_devices)
         grp = {"id": len(groups), "indices": [last],
                "slots": [slot_last],
                "subset": layers[last].pc.num_parts,
                "hetero_ok": False, "family": fam_last,
-               "mixed": False,
+               "mixed": False, "leaf": [was_leaf], "pinned": was_leaf,
                "owner_dims": layers[last].pc.dims,
                "owner_axes": layers[last].AXIS_NAMES}
         groups.append(grp)
@@ -1078,11 +1147,39 @@ def _run_group_hetero(machine, group: PlacementGroup,
     # would: GSPMD lowers cross-_pg slicing to gathers, measured as MORE
     # collectives than the legacy restack)
     prestacked = prestacked or [False] * len(ops)
+    leaf_flags = list(group.leaf_members or [False] * len(ops))
     metas = []
     legacy = []        # (slot, 1-D vec) for plain members
     pre_rows = []      # (slot, (G, L_m) row-local vectors) for prestacked
-    for m, p, g, pre in zip(ops, params_by_member, slots, prestacked):
-        if pre:
+    leaf_trees = []    # (G, ...)-stacked leaf trees for LEAF members
+    leaf_specs = []    # matching P("_pg", *spec) pytrees
+    leaf_pos = {}      # member index -> position in leaf_trees
+    for mi, (m, p, g, pre) in enumerate(zip(ops, params_by_member, slots,
+                                            prestacked)):
+        if leaf_flags[mi]:
+            # LEAF member (placed-op overlap, round 10): params keep
+            # their leaf structure and inner sharding, group-stacked
+            # exactly like the homogeneous path — zeros in unowned rows
+            # for legacy arrival, a row-local one-hot mask for
+            # block-resident (G, ...) arrival.  Leaf members run native
+            # on the owner grid (grouping pinned it), so their param
+            # specs name live mesh axes.
+            pspecs = m.param_specs()
+            tree = {}
+            for k, l in p.items():
+                if pre:
+                    io = jax.lax.broadcasted_iota(
+                        jnp.int32, (G,) + (1,) * (l.ndim - 1), 0)
+                    tree[k] = jnp.where(io == g, l, jnp.zeros_like(l))
+                else:
+                    z = jnp.zeros_like(l)
+                    tree[k] = jnp.stack([l if gg == g else z
+                                         for gg in range(G)])
+            leaf_pos[mi] = len(leaf_trees)
+            leaf_trees.append(tree)
+            leaf_specs.append({k: P("_pg", *pspecs[k]) for k in tree})
+            metas.append(None)
+        elif pre:
             leaves, treedef = jax.tree.flatten(p)
             check_f32_family(leaves, "param", m.name)
             for l in leaves:
@@ -1151,8 +1248,8 @@ def _run_group_hetero(machine, group: PlacementGroup,
             io == g, padded, jnp.zeros_like(padded))
 
     member_in_specs = [v[2] for v in views]
-    in_specs = (P("_pg", None), P("_pg", None)) + tuple(
-        s for specs in member_in_specs for s in specs)
+    in_specs = (P("_pg", None), P("_pg", None)) + tuple(leaf_specs) + \
+        tuple(s for specs in member_in_specs for s in specs)
     flat_inputs = [x for xs in inputs_by_member for x in xs]
     # the members' REAL global output avals (declared Tensor dtypes can be
     # stale under compute-dtype propagation): crop/cast targets
@@ -1215,7 +1312,9 @@ def _run_group_hetero(machine, group: PlacementGroup,
             off += size
         return jax.tree.unflatten(treedef, leaves)
 
-    def body(sp, st, *flat):
+    def body(sp, st, *rest):
+        leaf_sp = rest[:len(leaf_trees)]
+        flat = rest[len(leaf_trees):]
         local_vec = sp[0]
         local_svec = st[0]
         gidx = lax.axis_index("_pg")
@@ -1230,7 +1329,12 @@ def _run_group_hetero(machine, group: PlacementGroup,
 
         def raw_branch(m):
             def br(_):
-                p = unravel(local_vec, metas[m])
+                if leaf_flags[m]:
+                    # local row of the group-stacked leaf tree (inner
+                    # sharding intact) — no ravel round-trip
+                    p = jax.tree.map(lambda a: a[0], leaf_sp[leaf_pos[m]])
+                else:
+                    p = unravel(local_vec, metas[m])
                 s = unravel(local_svec, smetas[m])
                 res, new_st = ops[m].sharded_forward(
                     p, s, list(flat[offs[m]:offs[m + 1]]), train,
@@ -1284,7 +1388,7 @@ def _run_group_hetero(machine, group: PlacementGroup,
     out_specs = tuple(P("_pg", *spec) for spec in pos_spec) + \
         (P("_pg", None),)
     res = unchecked_shard_map(body, mesh, in_specs, out_specs)(
-        stacked, stacked_state, *flat_inputs)
+        stacked, stacked_state, *leaf_trees, *flat_inputs)
     new_svecs = res[n_pos]
     res = res[:n_pos]
     # crop each member's outputs back to its true global shapes/dtypes,

@@ -42,9 +42,20 @@ _DTYPE_BYTES = {"float32": 4, "int32": 4, "bfloat16": 2, "float16": 2,
 def dtype_bytes(dtype: str) -> int:
     """Bytes per element of a tensor dtype — the one sizing convention
     shared by the simulator's transfer costing (4-byte default, matching
-    native/simulator.cc) and the regrid planner's hop pricing
-    (parallel/regrid.py)."""
+    native/simulator.cc), the regrid planner's hop pricing
+    (parallel/regrid.py), and the search's pipeline boundary pricing."""
     return _DTYPE_BYTES.get(dtype, 4)
+
+
+def param_byte_scale(config) -> float:
+    """Scale factor from ``Op.param_bytes()``'s float32 convention to the
+    model's actual parameter STORAGE dtype (config.param_dtype) — 0.5
+    for bfloat16 masters-in-opt-state training, 1.0 for plain float32.
+    The single conversion point the search's comm-volume pricing and the
+    analytic roofline share, so a param_dtype change re-ranks searched
+    strategies instead of drifting between search and executor."""
+    pdtype = getattr(config, "param_dtype", "float32") or "float32"
+    return dtype_bytes(pdtype) / 4.0
 
 
 def shard_flops(op: Op, pc: ParallelConfig) -> float:
@@ -105,8 +116,12 @@ class AnalyticCostModel:
     fwd+bwd modeled as 3x forward (two extra GEMMs per matmul in backward —
     same factor the reference's measured fwd+bwd captures)."""
 
-    def __init__(self, perf: Optional[TpuChipPerf] = None):
+    def __init__(self, perf: Optional[TpuChipPerf] = None,
+                 param_scale: float = 1.0):
         self.perf = perf or TpuChipPerf()
+        # parameter-storage dtype scale (param_byte_scale): Op.param_bytes
+        # speaks float32; a bfloat16-stored model streams half those bytes
+        self.param_scale = param_scale
         # an analytic model has no measurement cache, but the search's
         # obs record reports cost-cache counters for EVERY cost model —
         # zeroed here so the record schema is uniform (no duck-typing at
@@ -125,7 +140,7 @@ class AnalyticCostModel:
         # (measured: the 9216x4096 FC at batch 64 costs ~the full-batch
         # op); each shard streams only ITS slice of a grid-sharded weight
         bytes_moved = 3.0 * (4.0 * io_elems / n_parts
-                             + op.param_bytes()
+                             + op.param_bytes() * self.param_scale
                              * param_shard_fraction(op, pc))
         p = self.perf
         eff = p.matmul_efficiency if type(op).__name__ in _MATMUL_OPS \

@@ -378,7 +378,10 @@ def shard_hbm_bytes(op: Op, pc: ParallelConfig) -> float:
     fp32 activation+gradient of the shard's actual input/output rects from
     :func:`op_geometry` — which knows about replication (a pure-c-TP
     Linear's every shard reads the FULL input; dividing by num_parts would
-    pass exactly the OOM plans this check exists to reject)."""
+    pass exactly the OOM plans this check exists to reject).  The 3x
+    param term holds for bfloat16 storage too: bf16 param + bf16 grad +
+    f32 momentum + f32 master = 12 bytes/param, the same total as the
+    f32 triple — mixed precision moves HBM *traffic*, not residency."""
     from flexflow_tpu.sim.cost_model import param_shard_fraction
 
     worst = 0
@@ -431,9 +434,18 @@ class StrategySearch:
         pipeline_candidate / pipeline_decision)."""
         from flexflow_tpu import obs as _obs
 
+        from flexflow_tpu.sim.cost_model import param_byte_scale
+
         self.model = model
         self.machine = machine or model.machine
-        self.cost_model = cost_model or AnalyticCostModel()
+        # parameter-storage dtype scale (mixed-precision round): every
+        # param-byte figure below — sync volume, the optimizer stream,
+        # the analytic roofline's weight-stream term — prices the bytes
+        # the executor actually moves under config.param_dtype
+        self._param_scale = param_byte_scale(
+            getattr(model, "config", None))
+        self.cost_model = cost_model or AnalyticCostModel(
+            param_scale=self._param_scale)
         self.max_per_axis = max_per_axis
         self.placement = placement
         self.obs = obs or _obs.NULL
@@ -575,7 +587,7 @@ class StrategySearch:
                 pbytes.append(0.0)
             else:
                 seen_param_keys.add(op.param_key)
-                pbytes.append(float(op.param_bytes()))
+                pbytes.append(float(op.param_bytes()) * self._param_scale)
         # two-pass cost resolution (round-3 ADVICE), measured models only
         # (sniffed like the flush below — an analytic model has no cache
         # or anchors to warm, so the extra pass would just double its
@@ -648,11 +660,16 @@ class StrategySearch:
             # abstraction unavailable (e.g. virtual machines: init's param
             # placement needs live devices) — fall back to the round-3
             # override heuristic: the FFModel default is the momentum
-            # state (== params), an override is treated as stateless SGD
+            # state (== params, in float32), doubled when master-weight
+            # mode adds a float32 master per parameter; an override is
+            # treated as stateless SGD
             from flexflow_tpu.model import FFModel
 
             if type(self.model).init_opt_state is FFModel.init_opt_state:
-                return total_param_bytes
+                f32_bytes = total_param_bytes / max(self._param_scale,
+                                                    1e-9)
+                return f32_bytes * (2.0 if self._param_scale != 1.0
+                                    else 1.0)
             return 0.0
 
     @staticmethod
@@ -773,7 +790,8 @@ class StrategySearch:
             layer_ops.append(op)
             layer_costs.append(self.cost_model.op_cost(op, cands[idx]))
         total_param_bytes = sum(
-            float(op.param_bytes()) for op in layer_ops)
+            float(op.param_bytes()) for op in layer_ops) \
+            * self._param_scale
         if stage_options is None:
             stage_options = [s for s in (2, 4, 8)
                              if n % s == 0 and s < n
@@ -836,7 +854,9 @@ class StrategySearch:
             stage_width = max(n // S, 1)   # devices per stage (= dp * tp)
             cdtype = getattr(getattr(self.model, "config", None),
                              "compute_dtype", "float32")
-            dt_bytes = 2.0 if cdtype in ("bfloat16", "float16") else 4.0
+            from flexflow_tpu.sim.cost_model import dtype_bytes
+
+            dt_bytes = float(dtype_bytes(cdtype))
             from flexflow_tpu.sim.collectives import _allreduce
 
             for tp in tp_opts:

@@ -346,8 +346,15 @@ def _load_step(ckpt_dir: str, step: int, model=None
             placed = {}
             for key, sub in tree.items():
                 ops_shard = shardings.get(key, {})
-                placed[key] = {k: put(v, ops_shard.get(k))
-                               for k, v in sub.items()}
+                # mixed-precision master leaves (<leaf>__master in the
+                # opt tree, see model._MASTER_SUFFIX) take the base
+                # param leaf's sharding — shardings are dtype-agnostic
+                placed[key] = {
+                    k: put(v, ops_shard.get(
+                        k, ops_shard.get(k[:-len("__master")]
+                                         if k.endswith("__master")
+                                         else k)))
+                    for k, v in sub.items()}
             return placed
 
         params = place(params)

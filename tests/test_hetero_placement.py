@@ -116,7 +116,9 @@ def test_hetero_losses_match_serialized_and_dp(monkeypatch):
     hetero_losses, _ = _losses(model)
 
     # serialized schedule: same strategy, hetero grouping disabled
+    # (both admission paths — vector and round-10 overlap leaf)
     monkeypatch.setattr(placement, "_hetero_eligible", lambda op: False)
+    monkeypatch.setattr(placement, "_overlap_eligible", lambda op: False)
     model2 = RnnModel(cfg, machine, _hetero_strategy(cfg, machine))
     serial_losses, _ = _losses(model2)
     monkeypatch.undo()
@@ -218,7 +220,12 @@ def test_hetero_overlap_structure(monkeypatch):
         return groups, compiled.as_text(), float(loss)
 
     groups_h, hlo_h, loss_h = build_and_compile()
+    # the serialized baseline must disable BOTH mixed-group admission
+    # paths: the vector path and the round-10 placed-overlap leaf path
+    # (otherwise _overlap_eligible re-fuses the convs and the control is
+    # no longer serialized)
     monkeypatch.setattr(placement, "_hetero_eligible", lambda op: False)
+    monkeypatch.setattr(placement, "_overlap_eligible", lambda op: False)
     groups_s, hlo_s, loss_s = build_and_compile()
     monkeypatch.undo()
 
@@ -479,7 +486,9 @@ def test_hetero_block_params_no_restack_penalty(monkeypatch):
     assert any(len(e.members) == 2 for e in
                ff_h._placement_schedule(frozenset())
                if isinstance(e, PlacementGroup))
+    # disable the round-10 leaf path too, so the control is serialized
     monkeypatch.setattr(placement, "_hetero_eligible", lambda op: False)
+    monkeypatch.setattr(placement, "_overlap_eligible", lambda op: False)
     _, c_s = compiled()
     monkeypatch.undo()
     assert c_h <= c_s, \

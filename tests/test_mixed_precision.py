@@ -1,0 +1,220 @@
+"""Mixed-precision training path (``--param-dtype bfloat16``, perf round).
+
+Params are STORED in bfloat16 (halved HBM residency + halved collective
+payloads); a float32 MASTER copy of every float leaf rides in the
+optimizer state under ``<leaf>__master``; update math runs in float32
+against the masters and the stored params are re-cast on write-back.
+These tests pin the policy down: storage/master dtype split, loss
+trajectories tracking pure-f32 within a documented tolerance, bit-exact
+master checkpoint resume, ``place_state`` round-trip of the mixed tree
+across an elastic shrink, the ``param_bytes_total`` gauge halving, and
+the simulator's byte accounting reflecting 2-byte params (A/B)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import _MASTER_SUFFIX, _opt_leaf_base, FFModel
+from flexflow_tpu.obs.metrics import read_textfile
+
+# bf16 has ~8 bits of mantissa; on a tiny CNN over a handful of steps the
+# loss drift vs pure-f32 stays well inside this (measured ~1e-3).
+LOSS_TOL = 2e-2
+
+
+def _model(machine, param_dtype="float32", tmp=None, ckpt_freq=0,
+           iters=6, momentum=0.0, metrics_path=""):
+    cfg = FFConfig(batch_size=8, input_height=16, input_width=16,
+                   num_iterations=iters, print_freq=0, num_classes=8,
+                   seed=7, param_dtype=param_dtype, momentum=momentum,
+                   ckpt_dir=str(tmp) if tmp else "", ckpt_freq=ckpt_freq,
+                   metrics_path=metrics_path)
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((8, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.batch_norm("bn1", t, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def _data(machine):
+    from flexflow_tpu.data import synthetic_batches
+
+    return synthetic_batches(machine, 8, 16, 16, num_classes=8,
+                             mode="random", seed=7)
+
+
+def _float_leaves(tree):
+    import jax.numpy as jnp
+
+    return {(key, k): v for key, sub in tree.items()
+            for k, v in sub.items()
+            if jnp.issubdtype(np.asarray(v).dtype, jnp.floating)}
+
+
+def _bytes_of(tree):
+    return sum(v.size * v.dtype.itemsize
+               for sub in tree.values() for v in sub.values())
+
+
+# ---------------------------------------------------------------------------
+# storage/master dtype split
+
+
+def test_bf16_storage_and_master_split(machine8):
+    ff32 = _model(machine8)
+    p32, _ = ff32.init()
+    ff16 = _model(machine8, param_dtype="bfloat16")
+    p16, _ = ff16.init()
+    o16 = ff16.init_opt_state(p16)
+
+    # every float param leaf is stored bf16; integer leaves untouched
+    for (key, k), v in _float_leaves(p16).items():
+        assert str(v.dtype) == "bfloat16", (key, k, v.dtype)
+
+    # optimizer state: f32 momentum per leaf plus an f32 master per
+    # FLOAT leaf, two-level tree ({param_key: {leaf: array}})
+    masters = {}
+    for key, sub in o16.items():
+        for k, v in sub.items():
+            assert str(v.dtype) != "bfloat16", (key, k)
+            if k.endswith(_MASTER_SUFFIX):
+                assert str(v.dtype) == "float32"
+                masters[(key, _opt_leaf_base(k))] = v
+    assert set(masters) == set(_float_leaves(p16))
+
+    # init invariant: params == masters.astype(bf16), masters == upcast
+    for (key, k), m in masters.items():
+        np.testing.assert_array_equal(
+            np.asarray(p16[key][k], "float32"), np.asarray(m))
+
+    # the headline byte win: float storage is exactly halved
+    assert _bytes_of(p16) * 2 == _bytes_of(p32)
+
+
+# ---------------------------------------------------------------------------
+# loss trajectories
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_bf16_losses_track_f32(machine8, momentum):
+    out32 = _model(machine8, momentum=momentum).fit(
+        _data(machine8), log=lambda *a: None)
+    out16 = _model(machine8, param_dtype="bfloat16", momentum=momentum).fit(
+        _data(machine8), log=lambda *a: None)
+    l32, l16 = out32["loss"], out16["loss"]
+    assert len(l16) == len(l32) == 6
+    assert all(np.isfinite(l16))
+    for a, b in zip(l32, l16):
+        assert abs(a - b) < LOSS_TOL, (l32, l16)
+    # both learn: same qualitative trajectory, not just closeness
+    assert l16[-1] < l16[0] and l32[-1] < l32[0]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: masters are the source of truth, resume is bit-exact
+
+
+def test_bf16_checkpoint_resume_bit_exact(tmp_path, machine8):
+    straight = _model(machine8, param_dtype="bfloat16").fit(
+        _data(machine8), log=lambda *a: None)
+
+    part1 = _model(machine8, param_dtype="bfloat16", tmp=tmp_path).fit(
+        _data(machine8), num_iterations=3, log=lambda *a: None)
+    assert part1["loss"] == straight["loss"][:3]
+
+    from flexflow_tpu.utils import checkpoint as ckpt
+
+    # the saved tree carries the f32 masters alongside bf16 params
+    _, p2, _, o2 = ckpt.restore_checkpoint(str(tmp_path))
+    for (key, k), v in _float_leaves(p2).items():
+        assert str(v.dtype) == "bfloat16"
+        m = o2[key][k + _MASTER_SUFFIX]
+        assert str(np.asarray(m).dtype) == "float32"
+        np.testing.assert_array_equal(
+            np.asarray(v), np.asarray(m).astype(v.dtype))
+
+    resumed = _model(machine8, param_dtype="bfloat16", tmp=tmp_path).fit(
+        _data(machine8), log=lambda *a: None)
+    # BIT-exact, not approx: resuming from the f32 masters loses nothing
+    assert resumed["loss"][-1] == straight["loss"][-1]
+
+
+# ---------------------------------------------------------------------------
+# place_state: the mixed bf16/f32 split survives an elastic regrid
+
+
+def test_place_state_mixed_tree_across_shrink(machine8):
+    import jax
+
+    ff8 = _model(machine8, param_dtype="bfloat16")
+    params, state = ff8.init()
+    opt = ff8.init_opt_state(params)
+
+    host = jax.tree.map(np.asarray, (params, state, opt))
+    ff4 = _model(machine8.shrink(range(4)), param_dtype="bfloat16")
+    p2, s2, o2 = ff4.place_state(*host)
+
+    live = set(ff4.machine.devices)
+    for tree, orig in ((p2, params), (s2, state), (o2, opt)):
+        for key, sub in tree.items():
+            for k, v in sub.items():
+                assert v.dtype == orig[key][k].dtype, (key, k)
+                np.testing.assert_array_equal(np.asarray(v),
+                                              np.asarray(orig[key][k]))
+                assert set(v.sharding.device_set) <= live, (key, k)
+    # master leaves landed (the shard_of fallback mapped them to their
+    # base leaf's sharding rather than dropping them)
+    assert any(k.endswith(_MASTER_SUFFIX)
+               for sub in o2.values() for k in sub)
+
+
+# ---------------------------------------------------------------------------
+# observability: parameter-residency gauge halves
+
+
+def test_param_bytes_gauge_halves(tmp_path, machine8):
+    vals = {}
+    for dt in ("float32", "bfloat16"):
+        path = str(tmp_path / f"{dt}.prom")
+        _model(machine8, param_dtype=dt, iters=2, metrics_path=path).fit(
+            _data(machine8), log=lambda *a: None)
+        vals[dt] = read_textfile(path)["param_bytes_total"]
+    assert vals["float32"] > 0
+    assert vals["bfloat16"] == vals["float32"] / 2
+
+
+# ---------------------------------------------------------------------------
+# simulator byte accounting (A/B): 2-byte params shrink modeled traffic
+
+
+def test_param_byte_scale_from_config():
+    from flexflow_tpu.sim.cost_model import param_byte_scale
+
+    assert param_byte_scale(FFConfig(param_dtype="float32")) == 1.0
+    assert param_byte_scale(FFConfig(param_dtype="bfloat16")) == 0.5
+    assert param_byte_scale(FFConfig(param_dtype="float16")) == 0.5
+
+
+def test_analytic_cost_drops_with_param_scale(machine8):
+    from flexflow_tpu.sim.cost_model import AnalyticCostModel
+
+    # param-heavy op so the param-byte term is visible in t_mem
+    ff = _model(machine8)
+    (fat,) = [op for op in ff.layers if op.name == "fc"]
+    c32 = AnalyticCostModel().op_cost(fat, fat.pc)
+    c16 = AnalyticCostModel(param_scale=0.5).op_cost(fat, fat.pc)
+    assert 0 < c16 < c32
+
+
+@pytest.mark.native
+def test_search_threads_param_scale(machine8):
+    from flexflow_tpu.sim.search import StrategySearch
+
+    ss32 = StrategySearch(_model(machine8), machine8)
+    ss16 = StrategySearch(_model(machine8, param_dtype="bfloat16"),
+                          machine8)
+    assert ss32._param_scale == 1.0
+    assert ss16._param_scale == 0.5

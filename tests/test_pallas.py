@@ -346,6 +346,192 @@ def test_maxpool_supported_gate():
     assert not supported(3, 3, 2, 2, 0, 0, "avg")  # avg pools stay XLA
 
 
+# ---------------------------------------------------------------------------
+# Pallas avg-pool backward (ops/pallas/avgpool.py): the non-overlapping /
+# global geometries where dx is a pure block upsample of dy — parity with
+# the canonical sum/count reduce_window pair under autodiff, including the
+# fused-ReLU mask from the pooled-output residual.
+
+
+def _ref_avgpool(x, kh, kw, sh, sw, relu):
+    from jax import lax
+
+    ones = jnp.ones_like(x)
+    s = lax.reduce_window(x, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
+                          ((0, 0),) * 4)
+    cnt = lax.reduce_window(ones, 0.0, lax.add, (1, kh, kw, 1),
+                            (1, sh, sw, 1), ((0, 0),) * 4)
+    y = s / cnt
+    return jax.nn.relu(y) if relu else y
+
+
+@pytest.mark.parametrize("relu", [False, True])
+@pytest.mark.parametrize("n,h,w,c,kh,kw,sh,sw", [
+    (2, 8, 8, 16, 8, 8, 1, 1),    # global pool, stride 1 (Inception tail)
+    (4, 8, 8, 3, 2, 2, 2, 2),     # 2x2 exact tiling, ragged C block
+    (2, 12, 9, 24, 3, 3, 3, 3),   # 3x3 tiling, h != w
+])
+def test_avgpool_parity(n, h, w, c, kh, kw, sh, sw, relu):
+    from flexflow_tpu.ops.pallas.avgpool import avgpool2d, supported
+
+    assert supported(kh, kw, sh, sw, 0, 0, h, w)
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(n, h, w, c), jnp.float32)
+
+    def f_pallas(x):
+        return avgpool2d(x, kh, kw, sh, sw, 0, 0, relu, interpret=True)
+
+    def f_ref(x):
+        return _ref_avgpool(x, kh, kw, sh, sw, relu)
+
+    y = f_pallas(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(f_ref(x)),
+                               rtol=1e-6, atol=1e-6)
+    g = jnp.asarray(rng.randn(*y.shape), jnp.float32)
+    gp = jax.grad(lambda x: jnp.vdot(f_pallas(x), g))(x)
+    gr = jax.grad(lambda x: jnp.vdot(f_ref(x), g))(x)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_avgpool_supported_gate():
+    from flexflow_tpu.ops.pallas.avgpool import supported
+
+    assert supported(8, 8, 1, 1, 0, 0, 8, 8)       # global, any stride
+    assert supported(2, 2, 2, 2, 0, 0, 12, 12)     # exact tiling
+    assert not supported(3, 3, 1, 1, 1, 1, 35, 35)  # overlap/pad stay XLA
+    assert not supported(3, 3, 3, 3, 0, 0, 10, 10)  # remainder rows
+    assert not supported(2, 2, 2, 2, 0, 0, 12, 12, "max")  # max stays XLA
+
+
+def test_pool2d_avg_routes_through_pallas_when_enabled(monkeypatch):
+    from flexflow_tpu.ops.base import Tensor
+    from flexflow_tpu.ops.pool import POOL_AVG, Pool2D
+    from flexflow_tpu.strategy import ParallelConfig
+
+    monkeypatch.setenv("FLEXFLOW_TPU_AVGPOOL", "1")
+    t = Tensor((2, 8, 8, 16))
+    op = Pool2D("p", ParallelConfig((1, 1, 1, 1), (0,)), t, 8, 8, 1, 1,
+                0, 0, POOL_AVG, relu=True)
+    assert op._use_pallas(None)
+    rng = np.random.RandomState(12)
+    x = jnp.asarray(rng.randn(2, 8, 8, 16), jnp.float32)
+    y_pal, _ = op.forward({}, {}, [x], train=True)
+    monkeypatch.setenv("FLEXFLOW_TPU_AVGPOOL", "0")
+    assert not op._use_pallas(None)
+    y_xla, _ = op.forward({}, {}, [x], train=True)
+    # 1/64 is a power of two: the kernel's constant-scale forward is
+    # bit-equal to the XLA path's sum/count divide here
+    np.testing.assert_array_equal(np.asarray(y_pal), np.asarray(y_xla))
+
+
+# ---------------------------------------------------------------------------
+# Fused batchnorm normalize+ReLU (ops/pallas/bn_act.py): one-pass backward
+# emitting dx plus both per-channel sums — parity with the unfused XLA
+# chain under autodiff for values and all three gradients.
+
+
+def _ref_bn_act(x, inv, shift, relu):
+    y = x * inv.astype(x.dtype) + shift.astype(x.dtype)
+    return jax.nn.relu(y) if relu else y
+
+
+@pytest.mark.parametrize("relu", [False, True])
+@pytest.mark.parametrize("n,h,w,c", [
+    (4, 4, 4, 16),    # single channel block
+    (4, 4, 4, 130),   # ragged C block (gc = 2, 2-lane tail)
+    (8, 1, 1, 7),     # post-flatten-like tiny channels
+])
+def test_bn_act_parity(n, h, w, c, relu):
+    from flexflow_tpu.ops.pallas.bn_act import bn_act, supported
+
+    assert supported(n, h, w, c)
+    rng = np.random.RandomState(13)
+    x = jnp.asarray(rng.randn(n, h, w, c), jnp.float32)
+    inv = jnp.asarray(rng.randn(c), jnp.float32)
+    shift = jnp.asarray(rng.randn(c), jnp.float32)
+    g = jnp.asarray(rng.randn(n, h, w, c), jnp.float32)
+
+    def f_pallas(x, inv, shift):
+        return bn_act(x, inv, shift, relu=relu, interpret=True)
+
+    np.testing.assert_allclose(
+        np.asarray(f_pallas(x, inv, shift)),
+        np.asarray(_ref_bn_act(x, inv, shift, relu)), rtol=1e-6, atol=1e-6)
+    gp = jax.grad(lambda *a: jnp.vdot(f_pallas(*a), g),
+                  argnums=(0, 1, 2))(x, inv, shift)
+    gr = jax.grad(lambda *a: jnp.vdot(_ref_bn_act(*a, relu), g),
+                  argnums=(0, 1, 2))(x, inv, shift)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_bn_act_supported_gate():
+    from flexflow_tpu.ops.pallas.bn_act import supported
+
+    assert supported(8, 4, 4, 64)
+    # M = 50 has no power-of-two row-block divisor: ragged rows would
+    # pollute the channel-sum accumulators, so the gate refuses
+    assert not supported(2, 5, 5, 64)
+
+
+def test_bn_act_bf16_inputs():
+    from flexflow_tpu.ops.pallas.bn_act import bn_act
+
+    rng = np.random.RandomState(14)
+    x = jnp.asarray(rng.randn(4, 4, 4, 16), jnp.bfloat16)
+    inv = jnp.asarray(rng.randn(16), jnp.float32)
+    shift = jnp.asarray(rng.randn(16), jnp.float32)
+    y = bn_act(x, inv, shift, relu=True, interpret=True)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32),
+        np.asarray(_ref_bn_act(x, inv, shift, True), np.float32),
+        rtol=2e-2, atol=2e-2)
+    gx = jax.grad(lambda x: bn_act(x, inv, shift, relu=True,
+                                   interpret=True).astype(jnp.float32)
+                  .sum())(x)
+    assert gx.dtype == jnp.bfloat16  # cotangents in the primal dtype
+
+
+def test_batchnorm_routes_through_pallas_when_enabled(monkeypatch):
+    """BatchNorm.forward takes the fused kernel under the env gate; loss
+    values, running stats, and the FULL gradient chain (through the
+    folded statistics, not just the elementwise tail) match the XLA
+    path."""
+    from flexflow_tpu.ops.base import Tensor
+    from flexflow_tpu.ops.norm import BatchNorm
+    from flexflow_tpu.strategy import ParallelConfig
+
+    t = Tensor((4, 8, 8, 16))
+    bn = BatchNorm("b", ParallelConfig((1, 1, 1, 1), (0,)), t, relu=True)
+    rng = np.random.RandomState(15)
+    x = jnp.asarray(rng.randn(4, 8, 8, 16), jnp.float32)
+    params = bn.init_params(jax.random.PRNGKey(0))
+    params = {"scale": params["scale"] + 0.3, "bias": params["bias"] - 0.1}
+    state = bn.init_state()
+
+    def run(p):
+        y, st = bn.forward(p, state, [x], train=True)
+        return jnp.sum(y * y), (y, st)
+
+    monkeypatch.setenv("FLEXFLOW_TPU_BNRELU", "1")
+    assert bn._use_pallas(x)
+    (l1, (y1, st1)), g1 = jax.value_and_grad(run, has_aux=True)(params)
+    monkeypatch.setenv("FLEXFLOW_TPU_BNRELU", "0")
+    assert not bn._use_pallas(x)
+    (l2, (y2, st2)), g2 = jax.value_and_grad(run, has_aux=True)(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-5, atol=1e-5)
+    for k in st1:
+        np.testing.assert_array_equal(np.asarray(st1[k]), np.asarray(st2[k]))
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   rtol=1e-4, atol=1e-4)
+
+
 @needs_maxpool_kernel
 def test_pool2d_routes_through_pallas_when_enabled(monkeypatch):
     """Pool2D.forward takes the kernel path under the env gate and the

@@ -1,0 +1,131 @@
+"""Flag-forwarding consistency check — wired into ``make check``.
+
+The repo has three training drivers sharing one FFConfig: the CNN zoo
+parses flags with ``FFConfig.from_args`` directly, but the LM and NMT
+drivers each carry their OWN elif-chain parser onto their own config
+dataclass (``TransformerConfig`` / ``RnnConfig``) which then forwards
+fields into the ``FFConfig(...)`` constructor.  Historically that made
+every new FFConfig knob a four-site edit that was easy to half-do: the
+flag would work for CNNs and silently parse-as-unknown (the reference
+parser's ignore-unknown contract) for LM/NMT.
+
+This check makes the drift a build failure: every FFConfig field with a
+CLI flag in ``from_args`` must either
+
+  1. have (one spelling of) its flag accepted by ``apps/lm.py`` AND
+     ``apps/nmt.py``, and have the field forwarded in the
+     ``FFConfig(...)`` construction of ``models/transformer.py`` AND
+     ``nmt/rnn_model.py``; or
+  2. be listed in CNN_ONLY below with the reason it does not apply to
+     the sequence drivers.
+
+Pure text analysis — the elif-chain is regex-extracted from the module
+SOURCE, so the check needs no jax and runs anywhere (including the
+native-only ``make check`` environment, like check_fault_kinds).
+
+    python tools/check_flag_forwarding.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# FFConfig fields whose flags intentionally do NOT exist on the LM/NMT
+# drivers.  Keyed by field name; the value is the reason (printed on
+# mismatch so a stale exemption explains itself).
+CNN_ONLY = {
+    "epochs": "LM/NMT are iteration-driven (-e is embed size in nmt)",
+    "print_freq": "LM/NMT log every iteration",
+    "dataset_path": "CNN data path; LM/NMT feed synthetic token batches",
+    "synthetic_input": "set via -d on the CNN driver only",
+    "strategy_file": "LM/NMT load --strategy directly, not via FFConfig",
+    "workers_per_node": "-ll:gpu drop-in compat flag on the CNN driver",
+    "loaders_per_node": "-ll:cpu drop-in compat flag on the CNN driver",
+    "weight_decay": "LM/NMT run plain SGD without decay (reference parity)",
+    "profiling": "jax.profiler wrap is CNN-driver-only today",
+    "trace_dir": "jax.profiler wrap is CNN-driver-only today",
+    "obs_max_bytes": "rollover tuning exposed on the CNN driver only",
+    "search_chains": "strategy search runs under the CNN driver only",
+    "search_delta": "strategy search runs under the CNN driver only",
+    "data_retry_attempts": "retrying sources wrap CNN file readers",
+    "data_skip_budget": "retrying sources wrap CNN file readers",
+    "elastic_search_iters": "re-search tuning exposed on the CNN driver",
+    "input_height": "image geometry",
+    "input_width": "image geometry",
+    "num_classes": "image label space",
+}
+
+_BRANCH = re.compile(
+    r'(?:el)?if a (?:in \(([^)]*)\)|== "([^"]+)")\s*:(?:\s*#[^\n]*)?\n'
+    r"(.*?)"
+    r"(?=\n\s+(?:el)?if a |\n\s+# unknown|\Z)", re.S)
+
+
+def config_flags(root: str) -> list:
+    """(flag spellings, FFConfig fields assigned) per from_args branch."""
+    src = open(os.path.join(root, "flexflow_tpu", "config.py")).read()
+    m = re.search(r"def from_args.*?return cfg", src, re.S)
+    if not m:
+        raise SystemExit("check_flag_forwarding: no from_args in "
+                         "flexflow_tpu/config.py")
+    out = []
+    for mm in _BRANCH.finditer(m.group(0)):
+        flags = re.findall(r'"([^"]+)"', mm.group(1) or "") or [mm.group(2)]
+        fields = re.findall(r"cfg\.(\w+)\s*=", mm.group(3))
+        if fields:
+            out.append((tuple(flags), tuple(fields)))
+    if len(out) < 20:  # from_args carries far more; a low count = bad parse
+        raise SystemExit(f"check_flag_forwarding: only {len(out)} flag "
+                         f"branches parsed from from_args — extractor bug?")
+    return out
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    root = argv[0] if argv else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def read(*parts):
+        return open(os.path.join(root, *parts)).read()
+
+    parsers = {"apps/lm.py": read("flexflow_tpu", "apps", "lm.py"),
+               "apps/nmt.py": read("flexflow_tpu", "apps", "nmt.py")}
+    forwards = {
+        "models/transformer.py":
+            read("flexflow_tpu", "models", "transformer.py"),
+        "nmt/rnn_model.py": read("flexflow_tpu", "nmt", "rnn_model.py")}
+
+    entries = config_flags(root)
+    problems = []
+    checked = 0
+    for flags, fields in entries:
+        exempt = [f for f in fields if f in CNN_ONLY]
+        if exempt:
+            continue
+        checked += 1
+        for name, text in parsers.items():
+            if not any(f'"{fl}"' in text for fl in flags):
+                problems.append(
+                    f"flag {'/'.join(flags)} (FFConfig.{fields[0]}) not "
+                    f"accepted by {name} — add it there or list the field "
+                    f"in CNN_ONLY with a reason")
+        for field in fields:
+            for name, text in forwards.items():
+                if not re.search(rf"\b{field}\s*=", text):
+                    problems.append(
+                        f"FFConfig.{field} not forwarded in {name}'s "
+                        f"FFConfig(...) construction")
+    if problems:
+        for p in problems:
+            print(f"check_flag_forwarding: FAIL: {p}")
+        return 1
+    print(f"check_flag_forwarding ok: {checked} shared flags present in "
+          f"both sequence-driver parsers and forwarded through both "
+          f"model configs ({len(entries) - checked} CNN-only exemptions)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
