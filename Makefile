@@ -1,8 +1,8 @@
 # Repo-level entry points; the native build lives in flexflow_tpu/native.
 PYTHON ?= python
 
-.PHONY: native check trace-smoke test bench-smoke fault-smoke budget-smoke \
-	elastic-smoke preempt-smoke rejoin-smoke
+.PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
+	budget-smoke elastic-smoke preempt-smoke rejoin-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -12,11 +12,27 @@ native:
 # every injectable fault kind must be documented in README.md's fault
 # table and covered by at least one test (tools/check_fault_kinds.py),
 # and every FFConfig CLI flag must be accepted by the LM/NMT parsers and
-# forwarded through their model configs (tools/check_flag_forwarding.py)
-check:
+# forwarded through their model configs (tools/check_flag_forwarding.py),
+# every emitted obs record kind must be rendered by obs/report.py and
+# covered by a test (tools/check_obs_kinds.py), and the static strategy
+# verifier must come up clean (lint)
+check: lint
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
+	$(PYTHON) tools/check_obs_kinds.py
 	$(MAKE) -C flexflow_tpu/native check
+
+# static verification (README "Static verification"): repo-wide python
+# lint (ruff when installed, pinned-subset stdlib fallback otherwise)
+# plus the three-pass compile-time strategy verifier — source/jaxpr/HLO
+# sync-freedom, donation/retrace, and the predicted-time grounded-accept
+# audit of the example strategy — on the 8-device virtual mesh
+lint:
+	$(PYTHON) tools/repo_lint.py
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.lint alexnet --devices 8 \
+	--ici-group 4 --strategy examples/strategies/alexnet_2x4.json
 
 # build libffsim.so and assert ffsim_simulate_trace produces a parseable
 # Chrome/Perfetto trace for a toy graph (obs/trace.py --smoke)

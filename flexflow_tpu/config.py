@@ -5,7 +5,7 @@ and ``DefaultConfig`` (cnn.cc:23-35)."""
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 from flexflow_tpu.strategy import Strategy
 

@@ -22,7 +22,7 @@ from __future__ import annotations
 import queue
 import threading
 import warnings
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterator, List, Tuple
 
 import numpy as np
 

@@ -35,7 +35,7 @@ import queue
 import threading
 import time
 import warnings
-from typing import Iterator, Optional
+from typing import Iterator
 
 _STOP_POLL_S = 0.1
 

@@ -5,7 +5,7 @@ placed data-parallel across the machine's devices."""
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Tuple
 
 import numpy as np
 

@@ -1584,6 +1584,8 @@ class FFModel:
                     import jax as _jax
 
                     try:
+                        # sync-ok: device-return recovery boundary — the
+                        # old mesh's losses must land before the regrid
                         kept = [float(v) for v in
                                 _jax.device_get(list(sig.losses))]
                     except Exception:
@@ -1830,8 +1832,10 @@ class FFModel:
                     batch = next(data_iter)
                     if it == warmup:
                         if loss is not None:
-                            float(loss)  # sync (block_until_ready is
-                            #              unreliable under the axon tunnel)
+                            # sync-ok: one-time warmup fence before the
+                            # timed window opens (block_until_ready is
+                            # unreliable under the axon tunnel)
+                            float(loss)
                         start = time.perf_counter()
                     try:
                         if sample_every and (it + 1) % sample_every == 0:
@@ -1952,6 +1956,8 @@ class FFModel:
                         host_sync_s += time.perf_counter() - tb0
                     if at_print:
                         tb0 = time.perf_counter()
+                        # sync-ok: print_freq-gated loss fetch, charged
+                        # to host_sync_s in the step budget
                         log(f"iter {it1}: loss = {float(loss):.4f}")
                         host_sync_s += time.perf_counter() - tb0
                     if at_ckpt:
@@ -2031,7 +2037,7 @@ class FFModel:
                         break
                     it += 1
                 if loss is not None:
-                    float(loss)
+                    float(loss)  # sync-ok: closes the timed window
                 elapsed = time.perf_counter() - start
         except BaseException:
             # error exit (host crash, device loss handed to the elastic
@@ -2082,6 +2088,7 @@ class FFModel:
         # end_step: last completed iteration (num_iterations normally;
         # the drained step after a graceful drain)
         end_step = it
+        # sync-ok: end-of-run loss materialization, outside the loop
         losses = [float(l) for l in jax.device_get(losses)]
         n_timed = end_step - warmup
         throughput = (n_timed * self.config.batch_size / elapsed

@@ -10,7 +10,6 @@ SOAP strategy search".  This is new model capability beyond the reference
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Optional
 
 from flexflow_tpu.config import FFConfig

@@ -22,8 +22,7 @@ everywhere (SURVEY.md §7 normalization note)."""
 from __future__ import annotations
 
 import dataclasses
-import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.machine import MachineModel

@@ -428,6 +428,31 @@ def _audit_bench_section(events: List[Dict]) -> List[str]:
     return lines
 
 
+def _lint_section(events: List[Dict]) -> List[str]:
+    lints = [e for e in events if e.get("kind") == "lint"]
+    if not lints:
+        return []
+    lines = ["== lint =="]
+    for rec in lints:
+        lines.append(
+            f"  verifier[{rec.get('model', '?')}]: "
+            f"{rec.get('error', 0)} error(s), "
+            f"{rec.get('warning', 0)} warning(s), "
+            f"{rec.get('exempted', 0)} exempted")
+        for f in rec.get("findings", []) or []:
+            lines.append(f"    {f.get('severity')} "
+                         f"[{f.get('pass_name')}:{f.get('code')}] "
+                         f"{f.get('message')}")
+        pred = rec.get("predicted")
+        if pred:
+            lines.append(
+                f"    predicted: searched {pred.get('searched_pred_s')} s"
+                f" vs dp {pred.get('dp_pred_s')} s "
+                f"({pred.get('mode')}) -> "
+                f"{'CONSISTENT' if pred.get('consistent') else 'CONTRADICTED'}")
+    return lines
+
+
 def _trace_section(events: List[Dict]) -> List[str]:
     traces = [e for e in events if e.get("kind") == "sim_trace"]
     if not traces:
@@ -455,7 +480,7 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "device_loss", "device_probe", "elastic_resize",
              "elastic_fallback", "elastic_refused", "elastic_rejoin",
              "device_return", "step_hang", "preempt_drain",
-             "ckpt_async"}
+             "ckpt_async", "lint"}
     lines = []
     for e in events:
         kind = e.get("kind")
@@ -483,8 +508,8 @@ def render(events: Iterable[Dict]) -> str:
     sections = [_header(events), _fit_section(events),
                 _fault_section(events), _elastic_section(events),
                 _search_section(events),
-                _audit_bench_section(events), _trace_section(events),
-                _misc_section(events)]
+                _audit_bench_section(events), _lint_section(events),
+                _trace_section(events), _misc_section(events)]
     return "\n".join("\n".join(s) for s in sections if s)
 
 
@@ -613,6 +638,13 @@ def summarize(events: Iterable[Dict]) -> Dict:
         out["bench"] = [{k: v for k, v in b.items()
                          if k not in ("run", "ts", "kind", "surface")}
                         for b in benches]
+    lints = [e for e in events if e.get("kind") == "lint"]
+    if lints:
+        rec = lints[-1]
+        out["lint"] = {k: rec.get(k) for k in
+                       ("model", "strategy", "error", "warning", "info",
+                        "exempted", "findings", "predicted", "donation")
+                       if rec.get(k) is not None}
     traces = [e for e in events if e.get("kind") == "sim_trace"]
     if traces:
         out["sim_trace"] = [{"path": t.get("path"),

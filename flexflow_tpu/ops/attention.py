@@ -20,7 +20,6 @@ SURVEY.md §2.6); cited rows: CP/ring-attention, SP."""
 
 from __future__ import annotations
 
-import math
 from typing import Dict, List
 
 from flexflow_tpu.ops.base import Op, Tensor

@@ -19,7 +19,6 @@ Compiled via Mosaic on TPU; interpreter mode elsewhere (CPU test suite).
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp

@@ -15,11 +15,9 @@ long-context path required of the framework.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -26,7 +26,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
-from typing import Dict, Iterable, Mapping, Sequence, Tuple
+from typing import Mapping, Sequence, Tuple
 
 
 @dataclasses.dataclass(frozen=True)

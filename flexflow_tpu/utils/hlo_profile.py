@@ -26,7 +26,7 @@ import gzip
 import json
 import re
 from collections import defaultdict
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 
 def device_op_times(logdir: str, steps: int = 1) -> Dict[str, float]:

@@ -1,0 +1,31 @@
+"""Compile-time strategy verifier (round 11): a multi-pass static
+analyzer over the jaxpr and optimized HLO of a jitted train step, plus
+the source of the fit hot path.
+
+Three passes:
+
+* **sync** (:mod:`.sync_lint`) — host round-trips: ``device_get`` /
+  ``block_until_ready`` / implicit ``float()`` concretization in the
+  per-step source region, host callbacks and infeed/outfeed in the
+  traced jaxpr and compiled HLO.  The "zero added per-step syncs"
+  invariant every robustness PR asserted in prose becomes a failing
+  check.
+* **donation** (:mod:`.donation_lint`) — the compiled executable's
+  input-output aliasing: large non-donated buffers whose shape matches
+  an output (an update that round-trips through a copy), plus a
+  retrace count per step function.
+* **predicted** (:mod:`.predicted`) — the grounded-accept audit in
+  predicted seconds: price both the searched and the DP compiled
+  programs' collectives with the calibrated two-tier ring formulas and
+  require the comm saving to fund the simulated claim
+  (``utils.hlo_audit.audit_consistent_time``).
+
+Entry point: ``python -m flexflow_tpu.apps.lint`` (``make lint``), with
+an exemption file where every exemption carries a reason string
+(:func:`findings.load_exemptions`).
+"""
+
+from flexflow_tpu.verify.findings import (Finding, apply_exemptions,
+                                          load_exemptions)
+
+__all__ = ["Finding", "apply_exemptions", "load_exemptions"]

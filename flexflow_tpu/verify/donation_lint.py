@@ -1,0 +1,142 @@
+"""Pass 2 — donation / recompile lint.
+
+The compiled executable's ``input_output_alias`` header is the ground
+truth of buffer donation: a large parameter that is NOT aliased but
+whose shape matches an output element is an update that round-trips
+through a fresh allocation every step (ROADMAP names threading donation
+through the train step).  The retrace check catches the other silent
+per-step cost: a step function whose jit cache grows past one entry is
+recompiling (weak-type / dtype / shape wobble between calls).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import List, Optional, Tuple
+
+from flexflow_tpu.verify.findings import Finding
+
+_DT = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s8": 1,
+       "u8": 1, "pred": 1, "f64": 8, "s64": 8}
+
+_SHAPE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _nbytes(dt: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DT.get(dt, 0)
+
+
+def parse_entry_shapes(hlo: str) -> Tuple[List[Tuple[str, str, str]],
+                                          List[Tuple[str, str]]]:
+    """(params, outputs) of the ENTRY computation: params as
+    ``(name, dtype, dims)`` in argument order, outputs as
+    ``(dtype, dims)`` tuple elements."""
+    m = re.search(r"^ENTRY [^\n(]*\((?P<p>.*)\)\s*->\s*(?P<o>.*?)\s*\{",
+                  hlo, re.M)
+    if not m:
+        raise ValueError("no ENTRY computation line in HLO text")
+    params = []
+    for pm in re.finditer(r"([\w.\-]+):\s*([a-z0-9]+)\[([0-9,]*)\]",
+                          m.group("p")):
+        params.append((pm.group(1), pm.group(2), pm.group(3)))
+    outputs = [(sm.group(1), sm.group(2))
+               for sm in _SHAPE.finditer(m.group("o"))]
+    return params, outputs
+
+
+def parse_donated_params(hlo: str) -> set:
+    """Parameter numbers the executable aliases to outputs:
+    ``input_output_alias={ {0}: (0, {}, may-alias), ... }``."""
+    # entries are '{out_idx}: (param, {}, may-alias)' — one nesting level
+    m = re.search(r"input_output_alias=\{((?:[^{}]|\{[^{}]*\})*)\}", hlo)
+    if not m:
+        return set()
+    return {int(g) for g in
+            re.findall(r"\}:\s*\((\d+),", m.group(1))}
+
+
+def donation_findings(hlo: str, min_bytes: int = 1 << 20,
+                      label: str = "step") -> List[Finding]:
+    """Flag non-donated entry parameters of at least ``min_bytes`` whose
+    (dtype, dims) matches an output element not already claimed by a
+    donated buffer — the updated-but-copied case.  Non-matching large
+    inputs (the batch) are reported at info level only."""
+    params, outputs = parse_entry_shapes(hlo)
+    donated = parse_donated_params(hlo)
+    # output shape budget: donated params consume their matching output
+    budget = Counter(outputs)
+    for i in donated:
+        if i < len(params):
+            key = (params[i][1], params[i][2])
+            if budget[key] > 0:
+                budget[key] -= 1
+    out: List[Finding] = []
+    for i, (name, dt, dims) in enumerate(params):
+        if i in donated:
+            continue
+        size = _nbytes(dt, dims)
+        if size < min_bytes:
+            continue
+        key = (dt, dims)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            out.append(Finding(
+                "donation", "non_donated", "error",
+                f"{label}:param{i}",
+                f"entry param {i} ({name}: {dt}[{dims}], "
+                f"{size / 1e6:.1f} MB) is not donated but an output of "
+                f"the same shape exists — the update copies instead of "
+                f"aliasing"))
+        else:
+            out.append(Finding(
+                "donation", "large_input", "info",
+                f"{label}:param{i}",
+                f"entry param {i} ({name}: {dt}[{dims}], "
+                f"{size / 1e6:.1f} MB) is not donated (no matching "
+                f"output shape — likely a batch input)"))
+    return out
+
+
+def retrace_findings(jitted, max_traces: int = 1,
+                     label: str = "step") -> List[Finding]:
+    """A jit cache deeper than ``max_traces`` after warm steps means the
+    step retraces per call (shape/dtype/weak-type wobble)."""
+    try:
+        n = jitted._cache_size()
+    except Exception as e:
+        return [Finding("donation", "retrace_unknown", "info",
+                        f"{label}:cache",
+                        f"cannot read jit cache size ({e})")]
+    if n > max_traces:
+        return [Finding(
+            "donation", "retrace", "error", f"{label}:cache",
+            f"step function holds {n} traces after warm calls "
+            f"(expected <= {max_traces}) — it recompiles per step")]
+    return [Finding("donation", "retrace_ok", "info", f"{label}:cache",
+                    f"jit cache holds {n} trace(s)")]
+
+
+def donation_summary(hlo: str) -> dict:
+    """Machine-readable aliasing totals for the lint report."""
+    params, _ = parse_entry_shapes(hlo)
+    donated = parse_donated_params(hlo)
+    total = sum(_nbytes(dt, dims) for _, dt, dims in params)
+    don = sum(_nbytes(dt, dims) for i, (_, dt, dims) in enumerate(params)
+              if i in donated)
+    return {"params": len(params), "donated": len(donated),
+            "param_bytes": total, "donated_bytes": don}
+
+
+def first_nondonated(hlo: str,
+                     min_bytes: int = 1 << 20) -> Optional[str]:
+    """Convenience for tests: the first error-level donation finding's
+    locus, or None when the program donates everything it updates."""
+    for f in donation_findings(hlo, min_bytes):
+        if f.severity == "error":
+            return f.where
+    return None

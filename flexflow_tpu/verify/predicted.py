@@ -1,0 +1,74 @@
+"""Pass 3 — predicted-time grounded accept as a lint pass.
+
+Runs the compiled-HLO audit (searched strategy vs pure DP) in-process on
+the virtual mesh and judges the strategy's own claim (its
+``__predicted__`` block, or an explicit ``--claimed-speedup``) with
+``audit_consistent_time`` — predicted seconds from the calibrated
+two-tier ring formulas, not byte counts.  A strategy that carries no
+claim gets the no-win rule (the plan may not pay more predicted comm
+time than DP) at warning level: there is no simulated number to
+contradict, only a smell.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from flexflow_tpu.verify.findings import Finding
+
+
+def predicted_findings(model_name: str, devices: int, ici_group: int,
+                       strategy_path: str,
+                       batch_size: Optional[int] = None,
+                       seed: int = 3, dtype: str = "float32",
+                       dcn_calibration: str = "",
+                       overrides: Optional[dict] = None,
+                       claimed_speedup: Optional[float] = None,
+                       ) -> Tuple[List[Finding], dict]:
+    """(findings, audit_summary) of the predicted-time pass."""
+    from flexflow_tpu.machine import Topology
+    from flexflow_tpu.strategy import Strategy
+    from flexflow_tpu.utils.hlo_audit import (audit_consistent_time,
+                                              audit_in_process)
+
+    claim_src = "flag"
+    if claimed_speedup is None:
+        pred = getattr(Strategy.load(strategy_path), "predicted",
+                       None) or {}
+        claimed_speedup = pred.get("speedup_vs_dp")
+        claim_src = "__predicted__" if claimed_speedup else "none"
+    topo = (Topology.from_calibration(dcn_calibration,
+                                      devices_per_ici_group=ici_group)
+            if dcn_calibration
+            else Topology(devices_per_ici_group=ici_group))
+    audit = audit_in_process(model_name, devices, ici_group,
+                             strategy_path, batch_size, seed, dtype,
+                             dcn_calibration=dcn_calibration,
+                             overrides=overrides)
+    verdict = audit_consistent_time(audit, claimed_speedup or 1.0, topo)
+    summary = {
+        "claimed_speedup": claimed_speedup, "claim_source": claim_src,
+        "searched_pred_s": verdict.get("searched_pred_s"),
+        "dp_pred_s": verdict.get("dp_pred_s"),
+        "searched_cross_mb": round(audit["searched_cross_bytes"] / 1e6, 3),
+        "dp_cross_mb": round(audit["dp_cross_bytes"] / 1e6, 3),
+        "mode": verdict["mode"], "consistent": verdict["consistent"],
+    }
+    findings: List[Finding] = []
+    where = f"{model_name}:{strategy_path}"
+    if verdict["consistent"]:
+        findings.append(Finding(
+            "predicted", "consistent", "info", where,
+            f"predicted comm {verdict.get('searched_pred_s')} s vs DP "
+            f"{verdict.get('dp_pred_s')} s supports the "
+            f"{'claimed %.2fx' % claimed_speedup if claimed_speedup else 'no-win'}"
+            f" plan ({verdict['mode']} mode)"))
+    else:
+        findings.append(Finding(
+            "predicted", "inconsistent",
+            "error" if claimed_speedup else "warning", where,
+            f"compiled program's predicted comm "
+            f"({verdict.get('searched_pred_s')} s) contradicts "
+            f"{'the claimed %.2fx win over' % claimed_speedup if claimed_speedup else 'parity with'}"
+            f" DP ({verdict.get('dp_pred_s')} s, {verdict['mode']} mode)"))
+    return findings, summary

@@ -2,8 +2,6 @@
 8-device CPU mesh (reference executables: cnn.cc, nmt/nmt.cc,
 scripts/simulator.cc)."""
 
-import json
-import os
 
 import numpy as np
 import pytest

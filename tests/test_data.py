@@ -1,7 +1,6 @@
 """Data subsystem tests: directory dataset, native JPEG pipeline, HDF5
 loader (SURVEY.md §2.1 loader rows)."""
 
-import os
 
 import numpy as np
 import pytest

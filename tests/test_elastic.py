@@ -10,10 +10,8 @@ import os
 import numpy as np
 import pytest
 
-import jax
 
 from flexflow_tpu.config import FFConfig
-from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.utils import elastic
 from flexflow_tpu.utils.retry import RetryPolicy

@@ -14,10 +14,8 @@ import threading
 import numpy as np
 import pytest
 
-import jax
 
 from flexflow_tpu.config import FFConfig
-from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.model import FFModel
 from flexflow_tpu.utils import elastic
 

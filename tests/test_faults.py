@@ -5,7 +5,6 @@ harness (utils/faultinject.py), the step health guard's three policies
 sources.  Tier-1: CPU, 8-device virtual mesh, no slow marker."""
 
 import math
-import os
 
 import numpy as np
 import pytest

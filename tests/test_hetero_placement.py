@@ -12,7 +12,6 @@ program carries strictly fewer global collectives than the serialized one
 (the structural critical-path win; wall-clock cannot discriminate on a
 shared-core virtual mesh — see test_hetero_overlap_structure)."""
 
-import time
 
 import pytest
 

@@ -1,0 +1,88 @@
+"""Regression corpus for the optimized-HLO collective counter (round 11
+satellite: the counter's known gaps — async pair double-count, tuple
+shapes, iota replica groups, unterminated final lines — are pinned by
+REAL snippet shapes committed under tests/data/hlo_corpus/, and a line
+the shape regex cannot consume fails loudly)."""
+
+import os
+
+import pytest
+
+from flexflow_tpu.utils.hlo_audit import (AuditParseError,
+                                          collective_bytes,
+                                          parse_collectives)
+
+_CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data", "hlo_corpus")
+
+
+def _load(name):
+    with open(os.path.join(_CORPUS, name)) as f:
+        return f.read()
+
+
+def test_async_pair_counted_once():
+    """An async pair is ONE transfer: the -start's tuple shape is
+    (operand, result) of the same buffer — summing it double-counts
+    (the pre-round-11 bug), and the -done half must add nothing."""
+    recs = parse_collectives(_load("async_pair.txt"), group_size=4)
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["op"] == "all-reduce-start" and r["async"]
+    assert r["bytes"] == 1024 * 256 * 4          # once, not twice
+    assert r["groups"] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert not r["cross"]                        # both groups intra
+
+
+def test_sync_tuple_shape_sums_variadic_operands():
+    recs = parse_collectives(_load("tuple_sync.txt"), group_size=4)
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == (128 + 64) * 4    # variadic: sum
+    assert recs[0]["cross"]                      # one group spans tiers
+    assert not recs[0]["async"]
+
+
+def test_iota_replica_groups_with_and_without_transpose():
+    recs = parse_collectives(_load("iota_groups.txt"), group_size=4)
+    ag = next(r for r in recs if r["op"] == "all-gather")
+    ar = next(r for r in recs if r["op"] == "all-reduce")
+    # [2,4]<=[8]: two consecutive groups of 4 — intra at group_size 4
+    assert ag["groups"] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert not ag["cross"]
+    assert ag["bytes"] == 256 * 4
+    # [4,2]<=[2,4]T(1,0): transposed iota pairs device i with i+4 — cross
+    assert ar["groups"] == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    assert ar["cross"]
+    assert ar["bytes"] == 16 * 2                 # bf16
+
+
+def test_permute_pairs_and_unterminated_final_line():
+    """source_target_pairs parse as 2-element groups; the final line
+    lacking a trailing newline (truncated dump) still counts."""
+    recs = parse_collectives(_load("permute_unterminated.txt"),
+                             group_size=4)
+    cp = next(r for r in recs if r["op"] == "collective-permute")
+    ar = next(r for r in recs if r["op"] == "all-reduce")
+    assert cp["groups"] == [[0, 4], [4, 0]] and cp["cross"]
+    assert ar["bytes"] == 512 * 4 and not ar["cross"]
+
+
+def test_unparsed_collective_line_raises_not_skips():
+    with pytest.raises(AuditParseError, match="unparsed collective"):
+        parse_collectives(_load("malformed.txt"), group_size=4)
+
+
+def test_missing_replica_groups_falls_back_to_all_devices():
+    hlo = ('  %all-reduce.1 = f32[8]{0} all-reduce(f32[8]{0} %x), '
+           'channel_id=1, to_apply=%add\n')
+    (r,) = parse_collectives(hlo, group_size=4, devices=8)
+    assert r["groups"] == [list(range(8))] and r["cross"]
+    (r,) = parse_collectives(hlo, group_size=4)  # devices unknown
+    assert r["groups"] == [] and not r["cross"]
+
+
+def test_collective_bytes_totals_match_records():
+    cross, intra = collective_bytes(_load("permute_unterminated.txt"),
+                                    group_size=4)
+    assert cross == 512 * 4                      # the permute
+    assert intra == 512 * 4                      # the 4-group all-reduce

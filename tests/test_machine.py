@@ -1,7 +1,6 @@
 """MachineModel / mesh construction tests."""
 
 import numpy as np
-import pytest
 
 from flexflow_tpu.machine import MachineModel, Topology
 from flexflow_tpu.strategy import ParallelConfig

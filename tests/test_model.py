@@ -3,9 +3,7 @@ hybrid strategies, and the key FlexFlow invariant — identical loss
 trajectories under any strategy (SURVEY.md §4)."""
 
 import numpy as np
-import pytest
 
-import jax
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.data import synthetic_batches

@@ -3,15 +3,11 @@ small variants for graph correctness (Inception needs multi-input concat
 plumbing; DenseNet exercises BN + concat chains; ResNet both modes)."""
 
 import numpy as np
-import pytest
 
-import jax
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.data import synthetic_batches
-from flexflow_tpu.models import (build_alexnet, build_densenet121,
-                                 build_inception_v3, build_resnet101,
-                                 build_vgg16)
+from flexflow_tpu.models import (build_densenet121, build_inception_v3, build_resnet101, build_vgg16)
 
 
 def cfg(h=224, w=224, b=2, classes=1000):

@@ -8,7 +8,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.models.transformer import TransformerConfig, TransformerLM
 from flexflow_tpu.ops.base import Tensor
 from flexflow_tpu.ops.moe import MixtureOfExperts

@@ -3,19 +3,17 @@ structure parity, weight sharing semantics, end-to-end training, and
 strategy invariance for the RNN path."""
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
 
-from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.nmt.rnn_model import (RnnConfig, RnnModel,
                                         default_global_config,
                                         synthetic_token_batches)
 from flexflow_tpu.ops.base import Tensor
 from flexflow_tpu.ops.embed import Embed
 from flexflow_tpu.ops.lstm import LSTMChunk
-from flexflow_tpu.strategy import ParallelConfig, Strategy
+from flexflow_tpu.strategy import ParallelConfig
 
 
 def small_cfg(**kw):

@@ -2,7 +2,6 @@
 pyramid level 1), on the 8-device CPU mesh with non-trivial grids."""
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

@@ -7,7 +7,6 @@ legacy serialized schedule exactly; losses must be BIT-identical either
 way (the overlap is a scheduling change, not a numeric one)."""
 
 import numpy as np
-import pytest
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.model import FFModel

@@ -8,7 +8,6 @@ import logging
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.ops.base import Tensor

@@ -1,7 +1,6 @@
 """DevicePrefetcher contracts (data/prefetch.py): determinism, exception
 propagation, clean shutdown, pass-through of pre-placed batches."""
 
-import threading
 import time
 
 import numpy as np

@@ -1,6 +1,5 @@
 """Profiling subsystem tests (SURVEY.md §5 tracing/profiling parity)."""
 
-import jax
 import jax.numpy as jnp
 
 from flexflow_tpu.config import FFConfig

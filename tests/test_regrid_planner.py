@@ -3,7 +3,6 @@ legacy per-trace path, coalescing accounting, fan-out sharing, and
 cost-aware hop selection."""
 
 import numpy as np
-import pytest
 
 from flexflow_tpu.machine import MachineModel
 from flexflow_tpu.strategy import ParallelConfig, Strategy

@@ -6,7 +6,6 @@ the hand-written result)."""
 
 import jax
 import numpy as np
-import pytest
 
 from flexflow_tpu.machine import MachineModel, Topology
 from flexflow_tpu.sim.search import StrategySearch, candidate_configs

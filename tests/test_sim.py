@@ -1,7 +1,6 @@
 """Simulator + MCMC search tests (SURVEY.md §4 level 4: simulator vs
 analytic schedules)."""
 
-import math
 
 import numpy as np
 import pytest
@@ -9,7 +8,6 @@ import pytest
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.machine import MachineModel, Topology
 from flexflow_tpu.model import FFModel
-from flexflow_tpu.sim.cost_model import AnalyticCostModel, TpuChipPerf
 from flexflow_tpu.sim.native import NativeSimulator
 from flexflow_tpu.sim.search import (StrategySearch, candidate_configs,
                                      op_geometry)

@@ -2,10 +2,7 @@
 with the reference's strategy.proto (validated against protoc output in
 test_proto_cross_validation)."""
 
-import math
 import subprocess
-import tempfile
-import os
 
 import pytest
 

@@ -7,9 +7,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from flexflow_tpu.machine import MachineModel
-from flexflow_tpu.models.transformer import (TransformerConfig, TransformerLM,
-                                             build_gpt_style)
+from flexflow_tpu.models.transformer import TransformerConfig, TransformerLM
 from flexflow_tpu.parallel.ring_attention import (blockwise_attention,
                                                   ring_attention)
 from flexflow_tpu.strategy import ParallelConfig, Strategy

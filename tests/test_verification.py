@@ -4,7 +4,6 @@ DISABLE_COMPUTATION, PRINT_INTERMEDIATE_RESULT / print_tensor."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.data import synthetic_batches

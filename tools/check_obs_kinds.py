@@ -1,0 +1,102 @@
+"""Obs-kind consistency check — wired into ``make check``.
+
+Every obs record kind emitted anywhere in ``flexflow_tpu/``
+(``*.event("<kind>", ...)`` call sites, plus the counter/gauge/timer
+kinds the RunLog methods synthesize) must be (1) rendered by
+``obs/report.py`` — either handled by a section/summarize entry or
+listed in ``_misc_section``'s ``known`` set — and (2) referenced by at
+least one test under ``tests/``.  A kind someone emits without wiring
+the report fails the build here, not when a user's run log renders as
+a raw dict (the same failure class ``tools/check_fault_kinds.py``
+closes for fault kinds).
+
+Pure text analysis — no jax, runs anywhere.
+
+    python tools/check_obs_kinds.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# RunLog.counter/.gauge/.timer synthesize these kinds internally
+_METHOD_KINDS = ("counter", "gauge", "timer")
+
+_EVENT = re.compile(r"\.event\(\s*[\"']([a-z_]+)[\"']", re.S)
+
+
+def emitted_kinds(root: str) -> dict:
+    """kind -> sorted list of emitting files (literal-kind call sites)."""
+    out: dict = {k: ["flexflow_tpu/obs/__init__.py"]
+                 for k in _METHOD_KINDS}
+    pkg = os.path.join(root, "flexflow_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            text = open(path).read()
+            for m in _EVENT.finditer(text):
+                out.setdefault(m.group(1), [])
+                if rel not in out[m.group(1)]:
+                    out[m.group(1)].append(rel)
+    if len(out) < 20:
+        raise SystemExit(
+            f"check_obs_kinds: extractor found only {len(out)} kinds — "
+            f"the .event() regex no longer matches the call sites")
+    return out
+
+
+def rendered_kinds(root: str, kinds) -> set:
+    """Kinds report.py knows: any quoted literal occurrence (section
+    filters, the _misc_section known set, summarize entries)."""
+    text = open(os.path.join(root, "flexflow_tpu", "obs",
+                             "report.py")).read()
+    return {k for k in kinds
+            if f'"{k}"' in text or f"'{k}'" in text}
+
+
+def tested_kinds(root: str, kinds) -> dict:
+    hits = {k: [] for k in kinds}
+    tdir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".py"):
+            continue
+        text = open(os.path.join(tdir, name)).read()
+        for k in kinds:
+            if k in text:
+                hits[k].append(name)
+    return hits
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    root = argv[0] if argv else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    emitted = emitted_kinds(root)
+    rendered = rendered_kinds(root, emitted)
+    tested = tested_kinds(root, emitted)
+    problems = []
+    for k in sorted(emitted):
+        if k not in rendered:
+            problems.append(
+                f"kind {k!r} (emitted by {', '.join(emitted[k])}) is not "
+                f"rendered by obs/report.py — add a section or list it "
+                f"in _misc_section's known set")
+        if not tested[k]:
+            problems.append(f"kind {k!r} not referenced by any test "
+                            f"under tests/")
+    if problems:
+        for p in problems:
+            print(f"check_obs_kinds: FAIL: {p}")
+        return 1
+    print(f"check_obs_kinds ok: {len(emitted)} obs kinds all rendered "
+          f"by obs/report.py and covered by tests/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,142 @@
+"""Repo-wide Python lint — the first leg of ``make lint``.
+
+Runs ``ruff check`` (config: ruff.toml, pinned rule set E9/F401/F811)
+when ruff is installed.  The container this repo grows in has no ruff
+and cannot install one, so a built-in fallback implements the same
+pinned subset in pure stdlib:
+
+* **E9** — syntax errors (``compile()``);
+* **F401** — unused module-level imports (``# noqa`` on the import
+  line opts out; ``__init__.py`` re-exports are exempt, matching the
+  per-file-ignores in ruff.toml);
+* **F811** — duplicate top-level def/class bindings.
+
+Either way the gate is the same: findings print as ``file:line code
+message`` and the exit status is 1 iff any exist.
+
+    python tools/repo_lint.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import shutil
+import subprocess
+import sys
+
+_SKIP_DIRS = {".git", "__pycache__", "native", ".pytest_cache", "build"}
+
+
+def _py_files(root: str):
+    for top in ("flexflow_tpu", "tools", "tests", "examples"):
+        base = os.path.join(root, top)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+    for name in sorted(os.listdir(root)):
+        if name.endswith(".py"):
+            yield os.path.join(root, name)
+
+
+def _import_bindings(stmt):
+    """(binding_name, lineno) pairs a module-level import introduces."""
+    out = []
+    if isinstance(stmt, ast.Import):
+        for a in stmt.names:
+            out.append((a.asname or a.name.split(".")[0], stmt.lineno))
+    elif isinstance(stmt, ast.ImportFrom):
+        if stmt.module == "__future__":
+            return []
+        for a in stmt.names:
+            if a.name == "*":
+                continue
+            out.append((a.asname or a.name, stmt.lineno))
+    return out
+
+
+def _used_names(tree) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # the base Name is walked separately
+    return used
+
+
+def _check_file(path: str, rel: str, findings) -> None:
+    src = open(path).read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        findings.append(f"{rel}:{e.lineno} E999 syntax error: {e.msg}")
+        return
+    lines = src.splitlines()
+    is_init = os.path.basename(path) == "__init__.py"
+    # __all__ entries count as uses (explicit re-export)
+    exported = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for el in getattr(stmt.value, "elts", []):
+                        if isinstance(el, ast.Constant):
+                            exported.add(str(el.value))
+    used = _used_names(tree) | exported
+    if not is_init:
+        for stmt in tree.body:
+            for name, lineno in _import_bindings(stmt):
+                if name in used:
+                    continue
+                if lineno <= len(lines) and "noqa" in lines[lineno - 1]:
+                    continue
+                findings.append(
+                    f"{rel}:{lineno} F401 {name!r} imported but unused")
+    seen = {}
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if stmt.name in seen and "noqa" not in \
+                    lines[stmt.lineno - 1]:
+                findings.append(
+                    f"{rel}:{stmt.lineno} F811 redefinition of "
+                    f"{stmt.name!r} (first at line {seen[stmt.name]})")
+            seen.setdefault(stmt.name, stmt.lineno)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    root = argv[0] if argv else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ruff = shutil.which("ruff")
+    if ruff:
+        proc = subprocess.run([ruff, "check", root])
+        print(f"repo_lint: ruff check -> rc {proc.returncode}")
+        return proc.returncode
+    findings = []
+    n = 0
+    for path in _py_files(root):
+        n += 1
+        _check_file(path, os.path.relpath(path, root), findings)
+    if n < 50:
+        print(f"repo_lint: FAIL: walked only {n} python files — the "
+              f"file walk is broken")
+        return 1
+    if findings:
+        for f in findings:
+            print(f"repo_lint: {f}")
+        print(f"repo_lint: {len(findings)} finding(s) over {n} files")
+        return 1
+    print(f"repo_lint ok: {n} python files clean "
+          f"(builtin E9/F401/F811 subset; install ruff for the full "
+          f"pinned set)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
