@@ -20,6 +20,7 @@ check: lint
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_strategies.py
 	$(MAKE) -C flexflow_tpu/native check
 
 # static verification (README "Static verification"): repo-wide python
@@ -58,9 +59,11 @@ bench-smoke:
 	assert rec['param_dtype'] == 'bfloat16', rec; \
 	assert rec['placed_overlap'] == 'on', rec; \
 	assert 'mfu_delta_vs_r05' in rec, rec; \
+	assert 'hlo_fingerprint' in rec, rec; \
 	print('bench-smoke ok:', {k: rec[k] for k in \
 	('value','regrid_hops','input_stall_s','comm_frac','stall_frac', \
-	'param_dtype','placed_overlap','mfu_delta_vs_r05')})"
+	'param_dtype','placed_overlap','mfu_delta_vs_r05', \
+	'hlo_fingerprint')})"
 
 # deterministic fault-injection smoke (robustness round): loss_nan +
 # data_io injected into a tiny HDF5-fed run with --on-divergence

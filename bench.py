@@ -169,6 +169,23 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
         floor = max(flops / peak, bytes_ / hbm_bw)
         if flops > 0 and floor > 0:
             extras["mfu_ceiling"] = round(flops / floor / peak, 4)
+            if mfu is not None:
+                # of_ceiling (VERDICT item 6): fraction of THIS
+                # program's honest roofline achieved — separates "the
+                # program is memory-bound" from "we left time on the
+                # table" in a way raw MFU can't
+                extras["of_ceiling"] = round(
+                    mfu / (flops / floor / peak), 4)
+        # compiled-program identity: line count + content hash of the
+        # optimized HLO, so two metric lines are comparable at a glance
+        # (same fingerprint = same program; an MFU move with a changed
+        # fingerprint is a different compilation, not a runtime win)
+        import hashlib
+
+        hlo_text = compiled.as_text()
+        extras["hlo_fingerprint"] = (
+            f"{len(hlo_text.splitlines())}:"
+            f"{hashlib.sha256(hlo_text.encode()).hexdigest()[:12]}")
         hbm_peak = None
         try:
             stats = machine.devices[0].memory_stats() or {}

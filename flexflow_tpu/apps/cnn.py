@@ -96,6 +96,23 @@ def main(argv=None, log=print) -> dict:
         else:
             cfg.num_classes = dataset.num_classes
 
+    if cfg.strategies:
+        # static plan check (verify/plan.py, round 12): vet the strategy
+        # against a shadow model built WITHOUT it, so rank/divisibility
+        # defects become a diagnostic list here instead of build-time
+        # ValueErrors or mid-compile tracebacks below; SystemExit(2) on
+        # errors, --allow-degraded keeps the old degrade-and-continue
+        import dataclasses as _dc
+
+        from flexflow_tpu.strategy import Strategy as _Strategy
+        from flexflow_tpu.verify.plan import check_plan
+
+        shadow_cfg = _dc.replace(cfg, strategies=_Strategy(),
+                                 strategy_file="")
+        check_plan(builders[model_name](shadow_cfg, machine),
+                   cfg.strategies, machine,
+                   allow_degraded=cfg.allow_degraded,
+                   label=cfg.strategy_file or "strategies")
     ff = builders[model_name](cfg, machine)
     log(ff.summary())
     # the data surface's obs sink: file-backed sources emit data_fault /

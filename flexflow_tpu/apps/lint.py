@@ -3,14 +3,20 @@
     python -m flexflow_tpu.apps.lint alexnet --devices 8 --ici-group 4 \
         --strategy examples/strategies/alexnet_2x4.json
 
-Runs the three verifier passes (flexflow_tpu/verify/):
+Runs the four verifier passes (flexflow_tpu/verify/):
 
-1. **sync** — source AST of the fit hot path, traced-jaxpr and
+1. **plan** (round 12) — the static strategy typechecker: per-op grid
+   legality (divisibility, device range/duplicates, degradation,
+   regrid reachability), pipeline-block consistency, and the
+   dtype-aware per-device HBM-fit prediction — all BEFORE any build or
+   compile, so a broken strategy file is a diagnostic list here
+   instead of a mid-build traceback;
+2. **sync** — source AST of the fit hot path, traced-jaxpr and
    compiled-HLO host-transfer scan of the jitted train step;
-2. **donation** — input-output aliasing of the compiled executable
+3. **donation** — input-output aliasing of the compiled executable
    (large non-donated update buffers) + a retrace count after two warm
    steps;
-3. **predicted** — the grounded-accept audit in predicted seconds
+4. **predicted** — the grounded-accept audit in predicted seconds
    (searched strategy vs DP, calibrated two-tier ring formulas) against
    the strategy's own ``__predicted__`` claim.
 
@@ -38,7 +44,8 @@ def parse_args(argv):
             "source_only": False, "skip_predicted": False,
             "overrides": None, "claimed_speedup": None,
             "dcn_calibration": "", "min_donation_mb": 1.0,
-            "obs_dir": "", "run_id": "", "steps": 2}
+            "obs_dir": "", "run_id": "", "steps": 2,
+            "allow_degraded": False}
     args = list(argv)
     if args and not args[0].startswith("-"):
         opts["model"] = args.pop(0)
@@ -79,6 +86,8 @@ def parse_args(argv):
             opts["obs_dir"] = val()
         elif a in ("-run-id", "--run-id"):
             opts["run_id"] = val()
+        elif a == "--allow-degraded":
+            opts["allow_degraded"] = True
     return opts
 
 
@@ -88,6 +97,38 @@ def _source_pass(repo):
     path = os.path.join(repo, "flexflow_tpu", "model.py")
     with open(path) as f:
         return source_sync_findings(f.read(), "flexflow_tpu/model.py")
+
+
+def _plan_pass(opts, findings, summary) -> bool:
+    """Static strategy typecheck + HBM-fit prediction (verify/plan.py)
+    against a shadow model built WITHOUT the strategy.  Returns False
+    when the plan has error findings — the build-dependent passes would
+    crash mid-construction on such a strategy, so the caller skips
+    them (their crash is exactly what this pass exists to replace)."""
+    import jax
+
+    from flexflow_tpu.machine import MachineModel, Topology
+    from flexflow_tpu.utils.hlo_audit import _build_model
+    from flexflow_tpu.verify.plan import (plan_findings,
+                                          strategy_file_findings)
+
+    ici = opts["ici_group"] or opts["devices"]
+    machine = MachineModel(
+        devices=jax.devices()[:opts["devices"]],
+        topology=Topology(devices_per_ici_group=ici))
+    fs, strategy = strategy_file_findings(opts["strategy"],
+                                          where_prefix="")
+    findings += fs
+    if strategy is not None:
+        shadow, _ = _build_model(
+            opts["model"], machine, opts["batch_size"], "",
+            opts["seed"], opts["dtype"], overrides=opts["overrides"])
+        pfs, summary["plan"] = plan_findings(
+            shadow, strategy, machine,
+            allow_degraded=opts["allow_degraded"])
+        findings += pfs
+    return not any(f.pass_name == "plan" and f.severity == "error"
+                   for f in findings)
 
 
 def _step_passes(opts, findings, summary):
@@ -171,11 +212,20 @@ def main(argv=None, log=print) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        _step_passes(opts, findings, summary)
-        ran_passes.add("donation")
-        if opts["strategy"] and not opts["skip_predicted"]:
-            _predicted_pass(opts, findings, summary)
-            ran_passes.add("predicted")
+        plan_ok = True
+        if opts["strategy"]:
+            plan_ok = _plan_pass(opts, findings, summary)
+            ran_passes.add("plan")
+        if plan_ok:
+            _step_passes(opts, findings, summary)
+            ran_passes.add("donation")
+            if opts["strategy"] and not opts["skip_predicted"]:
+                _predicted_pass(opts, findings, summary)
+                ran_passes.add("predicted")
+        elif not opts["json"]:
+            log("lint: plan errors — skipping the build-dependent "
+                "passes (sync/donation/predicted need a constructible "
+                "program)")
     exemptions = load_exemptions(
         opts["exemptions"]
         or os.path.join(repo, "flexflow_tpu", "verify", "exemptions.json"))

@@ -123,6 +123,8 @@ def parse_args(argv) -> TransformerConfig:
             cfg.transient_reset_steps = int(val())
         elif a == "--ckpt-async":
             cfg.ckpt_async = True
+        elif a == "--allow-degraded":
+            cfg.allow_degraded = True
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg
@@ -212,6 +214,17 @@ def main(argv=None, log=print) -> dict:
     machine = MachineModel()
     sf = getattr(cfg, "_strategy_file", "")
     loaded_strategies = Strategy.load(sf) if sf else None
+    if loaded_strategies is not None:
+        # static plan check (verify/plan.py, round 12): a shadow model
+        # built without the strategy vets per-op legality, the
+        # __pipeline__ block, and the per-device HBM fit as one
+        # diagnostic list — SystemExit(2) on errors instead of
+        # build-time ValueErrors / mid-compile tracebacks;
+        # --allow-degraded keeps the old degrade-and-continue behavior
+        from flexflow_tpu.verify.plan import check_plan
+
+        check_plan(TransformerLM(cfg, machine, None), loaded_strategies,
+                   machine, allow_degraded=cfg.allow_degraded, label=sf)
     if loaded_strategies is not None \
             and not getattr(cfg, "_pipeline_stages", 0) \
             and not getattr(cfg, "_microbatches", 0):

@@ -114,6 +114,8 @@ def parse_args(argv) -> RnnConfig:
             cfg.transient_reset_steps = int(val())
         elif a == "--ckpt-async":
             cfg.ckpt_async = True
+        elif a == "--allow-degraded":
+            cfg.allow_degraded = True
         # unknown flags ignored, like the reference parser
     cfg._strategy_file = strategy_file
     return cfg
@@ -126,6 +128,18 @@ def main(argv=None, log=print) -> dict:
     strategies = None
     if getattr(cfg, "_strategy_file", ""):
         strategies = Strategy.load(cfg._strategy_file)
+        # static plan check (verify/plan.py, round 12): fail fast with
+        # the diagnostic list instead of build-time ValueErrors or
+        # mid-compile tracebacks; --allow-degraded demotes degradation
+        # findings back to the old warn-and-continue.  NOTE: the NMT
+        # default strategy intentionally PINS the embeds to single
+        # devices (nmt/nmt.cc:269-308 parity) — those are honored
+        # placements, not degradations, so a clean file passes.
+        from flexflow_tpu.verify.plan import check_plan
+
+        check_plan(RnnModel(cfg, machine, None), strategies, machine,
+                   allow_degraded=cfg.allow_degraded,
+                   label=cfg._strategy_file)
     elif getattr(cfg, "_pipeline_stages", 0):
         from flexflow_tpu.nmt.rnn_model import pipeline_stage_strategy
 

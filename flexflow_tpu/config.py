@@ -172,6 +172,12 @@ class FFConfig:
     # save in flight; fit blocks only on the final save and before a
     # rollback restore.  Off by default — the sync path is unchanged.
     ckpt_async: bool = False
+    # static plan analyzer (verify/plan.py, round 12): the drivers fail
+    # fast on a strategy whose plan check reports errors; --allow-degraded
+    # demotes the promoted degradation diagnostics (replicated/normalized
+    # execution the machine previously only warned about) back to
+    # warnings, restoring the old degrade-and-continue behavior
+    allow_degraded: bool = False
 
     strategies: Strategy = dataclasses.field(default_factory=Strategy)
 
@@ -282,6 +288,8 @@ class FFConfig:
                 cfg.transient_reset_steps = int(val())
             elif a == "--ckpt-async":
                 cfg.ckpt_async = True
+            elif a == "--allow-degraded":
+                cfg.allow_degraded = True
             elif a == "--ckpt-dir":
                 cfg.ckpt_dir = val()
             elif a == "--ckpt-freq":

@@ -75,6 +75,9 @@ class TransformerConfig:
     hang_factor: float = 0.0
     hang_min_s: float = 60.0
     transient_reset_steps: int = 16
+    # static plan analyzer (verify/plan.py): demote degradation
+    # diagnostics to warnings (old degrade-and-continue behavior)
+    allow_degraded: bool = False
 
 
 class TransformerLM(FFModel):
@@ -119,6 +122,7 @@ class TransformerLM(FFModel):
             hang_factor=self.t.hang_factor,
             hang_min_s=self.t.hang_min_s,
             transient_reset_steps=self.t.transient_reset_steps,
+            allow_degraded=self.t.allow_degraded,
             strategies=strategies or Strategy(),
         )
         super().__init__(ff_cfg, machine)

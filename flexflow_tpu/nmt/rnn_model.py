@@ -86,6 +86,9 @@ class RnnConfig:
     hang_factor: float = 0.0
     hang_min_s: float = 60.0
     transient_reset_steps: int = 16
+    # static plan analyzer (verify/plan.py): demote degradation
+    # diagnostics to warnings (old degrade-and-continue behavior)
+    allow_degraded: bool = False
 
     @property
     def chunks_per_seq(self) -> int:
@@ -190,6 +193,7 @@ class RnnModel(FFModel):
             hang_factor=self.rnn.hang_factor,
             hang_min_s=self.rnn.hang_min_s,
             transient_reset_steps=self.rnn.transient_reset_steps,
+            allow_degraded=self.rnn.allow_degraded,
             strategies=strategies,
         )
         super().__init__(ff_cfg, machine)

@@ -329,11 +329,12 @@ def _fault_section(events: List[Dict]) -> List[str]:
 
 def _search_section(events: List[Dict]) -> List[str]:
     space = [e for e in events if e.get("kind") == "search_space"]
+    gates = [e for e in events if e.get("kind") == "plan_gate"]
     chunks = [e for e in events if e.get("kind") == "search_chunk"]
     results = [e for e in events if e.get("kind") == "search_result"]
     breakdown = [e for e in events if e.get("kind") == "search_breakdown"]
     pipes = [e for e in events if e.get("kind") == "pipeline_decision"]
-    if not (space or chunks or results):
+    if not (space or gates or chunks or results):
         return []
     lines = ["== strategy search =="]
     for s in space:
@@ -342,6 +343,13 @@ def _search_section(events: List[Dict]) -> List[str]:
             f"{s.get('candidates', '?')} candidates "
             f"({s.get('axis_options_pruned', 0)} axis options pruned, "
             f"{s.get('mem_rejected', 0)} HBM-rejected)")
+    for g in gates:
+        by = g.get("by_code") or {}
+        lines.append(
+            f"  plan gate: {g.get('checked', '?')} candidate grids "
+            f"checked, {g.get('rejected', 0)} rejected pre-sim"
+            + (f" ({', '.join(f'{k}={v}' for k, v in sorted(by.items()))})"
+               if by else ""))
     if chunks:
         curve = [c["best_time_s"] for c in chunks if "best_time_s" in c]
         acc = sum(c.get("accepted", 0) for c in chunks)
@@ -470,7 +478,7 @@ def _trace_section(events: List[Dict]) -> List[str]:
 def _misc_section(events: List[Dict]) -> List[str]:
     known = {"run_start", "compile", "step", "summary", "checkpoint_save",
              "checkpoint_restore", "sim_drift", "sim_drift_unavailable",
-             "op_time", "sim_trace", "search_space",
+             "op_time", "sim_trace", "search_space", "plan_gate",
              "search_chunk", "search_result", "search_breakdown",
              "pipeline_candidate", "pipeline_decision", "hlo_audit",
              "bench", "regrid_plan", "prefetch",
@@ -604,14 +612,19 @@ def summarize(events: Iterable[Dict]) -> Dict:
                 "measured": e.get("measured")} for e in per_op}
         out["op_time"] = ot
     space = [e for e in events if e.get("kind") == "search_space"]
+    gates = [e for e in events if e.get("kind") == "plan_gate"]
     chunks = [e for e in events if e.get("kind") == "search_chunk"]
     results = [e for e in events if e.get("kind") == "search_result"]
-    if space or chunks or results:
+    if space or gates or chunks or results:
         se: Dict = {}
         if space:
             se["space"] = {k: space[-1].get(k) for k in
                            ("ops", "candidates", "axis_options_pruned",
                             "mem_rejected", "devices", "cost_model")}
+        if gates:
+            se["plan_gate"] = {k: gates[-1].get(k) for k in
+                               ("checked", "rejected", "mem_rejected",
+                                "by_code")}
         if chunks:
             curve = [c["best_time_s"] for c in chunks
                      if "best_time_s" in c]

@@ -69,7 +69,6 @@ def _lstm_chunk_core():
     def core_bwd(res, cts):
         w_hh, hx, cx, ys, cs, ifgo = res
         d_ys, d_hy, d_cy = cts
-        H = hx.shape[-1]
         # time-major stacks of the PREVIOUS step's state
         h_prev = jnp.concatenate([hx[None], ys[:-1]], 0)
         c_prev = jnp.concatenate([cx[None], cs[:-1]], 0)

@@ -89,7 +89,6 @@ def _pick_blocks(H, W, C, N):
 @functools.lru_cache(maxsize=None)
 def _make_avgpool(shape, dtype_name, kh, kw, relu, interpret):
     N, H, W, C = shape
-    dt = jnp.dtype(dtype_name)
     OH, OW = H // kh, W // kw
     scale = 1.0 / float(kh * kw)
     bc, bn = _pick_blocks(H, W, C, N)

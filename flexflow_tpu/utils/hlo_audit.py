@@ -239,7 +239,11 @@ def _build_model(model_name: str, machine, batch_size: Optional[int],
             tc.batch_size = batch_size
         if model_name == "gpt":
             tc.causal = True
-        pp = getattr(strategies, "pipeline", None) if strategies else None
+        # explicit None test: a pipeline-only strategy has no per-op
+        # entries, so it is len()==0-falsy but must still build the
+        # PipelinedLM its block describes
+        pp = getattr(strategies, "pipeline", None) \
+            if strategies is not None else None
         if pp:
             from flexflow_tpu.parallel.pipeline import PipelinedLM
 

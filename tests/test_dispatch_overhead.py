@@ -1,0 +1,118 @@
+"""Anchor ``dispatch_overhead_cost`` (sim/collectives.py) against the
+compiled executor (round 12, satellite of the plan-analyzer PR).
+
+The model charges placed (non-canonical device list) execution one
+hierarchical broadcast of the op's inputs plus one of its outputs per
+program half — ``2.0 * 0.5 * (allreduce(in) + allreduce(out))``.  This
+test compiles the FORWARD program of a small net (the eval step: the DP
+baseline has no collectives beyond the scalar loss/acc reductions, so
+every byte the placed variant adds IS the entry/exit dispatch traffic)
+and checks the model's charged volume against the HLO audit's byte
+count, in the audit's own convention: an all-reduce of V moves 2V and
+the compiled gather/restack trees likewise total ~2V of audited
+buffers, so the model's forward-half charge is ``2 * (in + out)``
+bytes.  Within 2x, for each placed family the executor lowers: an
+irregular SET, an aligned BLOCK, and a HETERO group (two ops placed on
+disjoint blocks).
+"""
+
+import jax
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.data import synthetic_batches
+from flexflow_tpu.machine import MachineModel, Topology
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.sim.collectives import dispatch_overhead_cost
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+from flexflow_tpu.utils.hlo_audit import collective_bytes
+
+IRREGULAR = (0, 3, 5, 6)
+
+
+def _build(strategies):
+    machine = MachineModel(topology=Topology(devices_per_ici_group=4))
+    cfg = FFConfig(batch_size=16, input_height=8, input_width=8,
+                   learning_rate=1e-3, seed=9, strategies=strategies)
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((16, 8, 8, 8), name="image")
+    t = ff.flat("flat", img)
+    t = ff.linear("fc1", t, 256, relu=True)
+    ff.softmax("softmax", ff.linear("fc2", t, 64, relu=False))
+    return ff
+
+
+def _forward_collective_bytes(ff):
+    params, state = ff.init()
+    step = ff.make_eval_step()
+    img, lbl = next(synthetic_batches(ff.machine, 16, 8, 8, mode="ones",
+                                      channels=8))
+    hlo = step.lower(params, state, img, lbl).compile().as_text()
+    cross, intra = collective_bytes(hlo, 4)
+    return cross + intra
+
+
+def _model_forward_bytes(ff, placed):
+    """The forward half of the dispatch model's charge, in audit bytes:
+    2 x (input + output footprint) per placed op."""
+    charge = 0.0
+    for op in ff.layers:
+        if op.name not in placed:
+            continue
+        inb = 4 * sum(t.size() for t in op.inputs)
+        outb = 4 * sum(t.size() for t in op.all_outputs())
+        charge += 2.0 * (inb + outb)
+    return charge
+
+
+PLACEMENTS = {
+    "set": {"fc1": ParallelConfig((4, 1), IRREGULAR)},
+    "block": {"fc1": ParallelConfig((4, 1), (4, 5, 6, 7))},
+    "hetero": {"fc1": ParallelConfig((4, 1), (0, 1, 2, 3)),
+               "fc2": ParallelConfig((4, 1), (4, 5, 6, 7))},
+}
+
+
+@pytest.fixture(scope="module")
+def baseline_bytes():
+    if len(jax.devices()) != 8:
+        pytest.skip("audit assumes the 8-device test mesh")
+    return _forward_collective_bytes(_build(Strategy()))
+
+
+def test_dp_forward_is_collective_free(baseline_bytes):
+    # the isolation premise: DP forward moves only the scalar loss/acc
+    # reductions, so placed-minus-baseline is pure dispatch traffic
+    assert baseline_bytes < 1024
+
+
+@pytest.mark.parametrize("family", sorted(PLACEMENTS))
+def test_model_charge_anchored_to_compiled(family, baseline_bytes):
+    placed = PLACEMENTS[family]
+    s = Strategy()
+    for name, pc in placed.items():
+        s[name] = pc
+    ff = _build(s)
+    actual = _forward_collective_bytes(ff) - baseline_bytes
+    charge = _model_forward_bytes(ff, placed)
+    ratio = actual / charge
+    print(f"dispatch[{family}]: compiled {actual / 1e3:.1f} KB vs model "
+          f"{charge / 1e3:.1f} KB (ratio {ratio:.2f})")
+    assert 0.5 <= ratio <= 2.0, \
+        f"{family}: model charge off by {ratio:.2f}x (> 2x)"
+
+
+def test_cost_gates_on_executor_eligibility(baseline_bytes):
+    # the seconds-valued model itself: charged for a placed config,
+    # free for the canonical full machine and for configs the executor
+    # normalizes (duplicate ids -> no placement group lowered)
+    ff = _build(Strategy())
+    topo = ff.machine.topology
+    fc1 = next(op for op in ff.layers if op.name == "fc1")
+    placed = dispatch_overhead_cost(
+        fc1, ParallelConfig((4, 1), IRREGULAR), topo, 8)
+    assert placed > 0.0
+    assert dispatch_overhead_cost(
+        fc1, ParallelConfig((8, 1), tuple(range(8))), topo, 8) == 0.0
+    assert dispatch_overhead_cost(
+        fc1, ParallelConfig((4, 1), (0, 0, 1, 2)), topo, 8) == 0.0

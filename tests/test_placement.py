@@ -188,7 +188,7 @@ def test_output_placed_on_member_block(machine8):
 
     a = _linear("a", ParallelConfig((1, 4), (0, 1, 2, 3)))
     b = _linear("b", ParallelConfig((1, 4), (4, 5, 6, 7)))
-    grp = plan_schedule([a, b], 8)[0]
+    plan_schedule([a, b], 8)
     mesh = machine8.placement_mesh((1, 4), ("c", "n"))
 
     # the stacked (G, ...) result is sharded over _pg: slot g's slice is

@@ -1,0 +1,313 @@
+"""Static plan analyzer (round 12, flexflow_tpu/verify/plan.py): the
+strategy typechecker.
+
+Seeds the six invalid-plan classes the tentpole names — divisibility,
+duplicate device, out-of-range device, unreachable regrid, broken
+pipeline block, OOM — and asserts each is rejected with its SPECIFIC
+diagnostic code by pure static analysis: no jit, no native simulator,
+no model execution (the models are built, never compiled).  Plus every
+placement.py degradation case as a structured diagnostic (error by
+default, warning under --allow-degraded), the driver fail-fast path,
+and the structural file checks.
+"""
+
+import json
+
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.strategy import ParallelConfig, Strategy
+from flexflow_tpu.verify.plan import (check_plan, op_findings,
+                                      pipeline_findings, plan_findings,
+                                      strategy_file_findings)
+
+
+@pytest.fixture(scope="module")
+def machine8():
+    return MachineModel.virtual(8)
+
+
+@pytest.fixture(scope="module")
+def alexnet8(machine8):
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    return build_alexnet(FFConfig(batch_size=64), machine8)
+
+
+@pytest.fixture(scope="module")
+def lm8(machine8):
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+
+    return TransformerLM(
+        TransformerConfig(batch_size=8, seq_length=64, num_layers=1,
+                          d_model=64, num_heads=4, d_ff=128,
+                          vocab_size=512), machine8, None)
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+def _one(model, machine, name, dims, devices, **kw):
+    s = Strategy()
+    s[name] = ParallelConfig(tuple(dims), tuple(devices))
+    fs, _summary = plan_findings(model, s, machine, **kw)
+    return fs
+
+
+# ---------------------------------------------------------------- the six
+
+
+def test_duplicate_device_rejected(alexnet8, machine8):
+    fs = _one(alexnet8, machine8, "linear2", (1, 4), (0, 1, 1, 2))
+    assert "device_dup" in _codes(fs)
+    f = next(f for f in fs if f.code == "device_dup")
+    assert f.severity == "error" and "duplicate" in f.message
+    assert f.where == "linear2"
+
+
+def test_out_of_range_device_rejected(alexnet8, machine8):
+    fs = _one(alexnet8, machine8, "linear2", (1, 4), (0, 1, 2, 9))
+    assert "device_range" in _codes(fs)
+    f = next(f for f in fs if f.code == "device_range")
+    assert f.severity == "error" and "8" in f.message
+
+
+def test_ragged_divisibility_rejected(alexnet8, machine8):
+    # 4096 outputs over 3 parts: the ragged non-dividing shard case
+    fs = _one(alexnet8, machine8, "linear2", (3, 1), (0, 1, 2))
+    assert "divisibility" in _codes(fs)
+    f = next(f for f in fs if f.code == "divisibility")
+    assert f.severity == "error"
+    assert "4096" in f.message and "3" in f.message
+
+
+def test_unreachable_regrid_rejected():
+    # 12 devices factor as [2, 2, 3]: a canonical (2, 6) grid needs a
+    # factor-6-then-2 split the global mesh cannot express — the only
+    # statically unreachable regrid class (greedy failures still reach
+    # via gather + re-split and are warnings, tested below)
+    machine12 = MachineModel.virtual(12)
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    ff = build_alexnet(FFConfig(batch_size=48), machine12)
+    fs = _one(ff, machine12, "linear2", (2, 6), tuple(range(12)))
+    assert "regrid_unreachable" in _codes(fs)
+    f = next(f for f in fs if f.code == "regrid_unreachable")
+    assert f.severity == "error"
+
+
+def test_broken_pipeline_block_rejected(lm8, machine8):
+    s = Strategy()
+    s.pipeline = {"stages": 3, "microbatches": 2, "tp": 1}
+    fs, _ = plan_findings(lm8, s, machine8)
+    assert "pipeline" in _codes(fs)
+    f = next(f for f in fs if f.code == "pipeline")
+    assert f.severity == "error" and f.where == "__pipeline__"
+    assert "3 stages" in f.message
+
+
+def test_pipeline_microbatch_mismatch_rejected(lm8, machine8):
+    s = Strategy()
+    s.pipeline = {"stages": 2, "microbatches": 5, "tp": 1}
+    fs, _ = plan_findings(lm8, s, machine8)
+    pipe = [f for f in fs if f.code == "pipeline"]
+    assert pipe and any("5" in f.message for f in pipe)
+
+
+def test_oom_rejected(alexnet8, machine8):
+    fs, summary = plan_findings(alexnet8, Strategy(), machine8,
+                                hbm_capacity=1e6)
+    oom = [f for f in fs if f.code == "oom"]
+    assert oom and all(f.severity == "error" for f in oom)
+    assert oom[0].where.startswith("device")
+    assert summary["memory"]["over_devices"] == len(oom)
+
+
+# ------------------------------------------- degradation + other classes
+
+
+def test_rank_mismatch_rejected(alexnet8, machine8):
+    fs = _one(alexnet8, machine8, "linear2", (2, 2, 2), tuple(range(8)))
+    assert _codes(fs) == ["rank"]
+
+
+def test_degraded_replicated_is_structured_error(alexnet8, machine8):
+    # (3,1) on 3 of 8 devices: N % parts != 0 -> the executor would warn
+    # and run fully replicated; the checker promotes that to a
+    # structured error carrying the machine size
+    fs = _one(alexnet8, machine8, "linear2", (3, 1), (1, 2, 3))
+    f = next(f for f in fs if f.code == "degraded_replicated")
+    assert f.severity == "error" and "replicated" in f.message
+
+
+def test_degraded_normalized_is_structured_error(lm8, machine8):
+    # LayerNormSeq is not set-placeable: a 2-device non-canonical grid
+    # is legal arithmetic but the executor normalizes the device list
+    fs = _one(lm8, machine8, "blk0_ln1", (1, 2), (1, 2))
+    assert _codes(fs) == ["degraded_normalized"]
+    assert fs[0].severity == "error"
+
+
+def test_allow_degraded_demotes_to_warning(lm8, machine8):
+    fs = _one(lm8, machine8, "blk0_ln1", (1, 2), (1, 2),
+              allow_degraded=True)
+    assert _codes(fs) == ["degraded_normalized"]
+    assert fs[0].severity == "warning"
+
+
+def test_honored_set_placement_is_clean(alexnet8, machine8):
+    # point-placeable ops on an irregular duplicate-free set ARE honored
+    # by the executor (set family) — the checker must not cry wolf
+    fs = _one(alexnet8, machine8, "linear2", (2, 1), (1, 5))
+    assert fs == []
+
+
+def test_multi_axis_spec_divisibility(machine8):
+    # a spec entry may be a TUPLE of grid axes (one tensor dim sharded
+    # by their product — the multi-axis carve-out in
+    # Op.validate_partitioning); the checker applies the same product
+    # rule: 12 elements over c*n = 2*2 divides, over 2*4 does not
+    from flexflow_tpu.ops.base import Op, Tensor
+
+    class _MultiAxisOp(Op):
+        AXIS_NAMES = ("c", "n")
+
+        def __init__(self, pc):
+            super().__init__("multi", pc, [])
+            self.output = Tensor((12,), "float32", self, "multi")
+
+        def output_spec(self):
+            from jax.sharding import PartitionSpec as P
+
+            return P(("c", "n"))
+
+    pc = ParallelConfig((2, 4), tuple(range(8)))
+    fs = op_findings(_MultiAxisOp(pc), pc, machine8)
+    assert "divisibility" in _codes(fs)
+    f = next(f for f in fs if f.code == "divisibility")
+    assert "12" in f.message and "8" in f.message
+    ok = ParallelConfig((2, 2), tuple(range(4)))
+    assert op_findings(_MultiAxisOp(ok), ok,
+                       MachineModel.virtual(4)) == []
+
+
+def test_unknown_op_is_warning(alexnet8, machine8):
+    fs = _one(alexnet8, machine8, "no_such_op", (1, 4), (0, 1, 2, 3))
+    assert _codes(fs) == ["unknown_op"]
+    assert fs[0].severity == "warning"
+
+
+def test_greedy_regrid_is_warning_not_error(machine8):
+    # reachable-but-expensive regrids (gather + re-split) warn; the sim
+    # prices them, the executor runs them — only unreachable is an error
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    ff = build_alexnet(FFConfig(batch_size=64), machine8)
+    s = Strategy()
+    s["conv1"] = ParallelConfig((2, 1, 1, 4), tuple(range(8)))
+    s["conv2"] = ParallelConfig((1, 1, 1, 8), tuple(range(8)))
+    fs, _ = plan_findings(ff, s, machine8)
+    assert all(f.severity != "error" for f in fs)
+
+
+def test_clean_default_plan(alexnet8, machine8):
+    fs, summary = plan_findings(alexnet8, Strategy(), machine8)
+    assert fs == []
+    assert summary["ops"] == len(alexnet8.layers)
+    assert summary["memory"]["max_device_bytes"] > 0
+
+
+def test_clean_committed_strategy(machine8):
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "strategies",
+        "alexnet_2x4.json")
+    fs, strategy = strategy_file_findings(path)
+    assert fs == [] and strategy is not None
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    ff = build_alexnet(FFConfig(batch_size=64), machine8)
+    pfs, _ = plan_findings(ff, strategy, machine8)
+    assert [f for f in pfs if f.severity == "error"] == []
+
+
+# ------------------------------------------------------- file structure
+
+
+def test_file_bad_dims_and_grid_size(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({
+        "a": {"dims": [0, 2], "devices": [0, 1]},
+        "b": {"dims": [2], "devices": [0, 1, 2]},
+        "c": "not a grid"}))
+    fs, strategy = strategy_file_findings(str(p))
+    codes = _codes(fs)
+    assert "bad_dims" in codes and "grid_size" in codes
+    assert "parse" in codes
+    # well-formed entries still load (partial strategy for later passes)
+    assert strategy is not None
+
+
+def test_file_unparseable(tmp_path):
+    p = tmp_path / "garbage.json"
+    p.write_text("{not json")
+    fs, strategy = strategy_file_findings(str(p))
+    assert strategy is None
+    assert _codes(fs) == ["parse"]
+
+
+def test_pipeline_findings_direct(lm8, machine8):
+    fs = pipeline_findings({"stages": 2, "microbatches": 2, "tp": 3},
+                           lm8, machine8)
+    assert fs and all(f.code == "pipeline" for f in fs)
+
+
+# ------------------------------------------------- driver fail-fast path
+
+
+def test_check_plan_raises_systemexit(alexnet8, machine8, capsys):
+    s = Strategy()
+    s["linear2"] = ParallelConfig((1, 4), (0, 1, 1, 2))
+    with pytest.raises(SystemExit) as e:
+        check_plan(alexnet8, s, machine8, label="unit")
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "device_dup" in err and "unit" in err
+
+
+def test_check_plan_allow_degraded_passthrough(lm8, machine8, capsys):
+    # the --allow-degraded contract: a legal-but-degraded plan refuses
+    # by default and passes (warning only) when the flag is set
+    s = Strategy()
+    s["blk0_ln1"] = ParallelConfig((1, 2), (1, 2))
+    with pytest.raises(SystemExit):
+        check_plan(lm8, s, machine8, label="unit")
+    fs = check_plan(lm8, s, machine8, allow_degraded=True, label="unit")
+    assert [f for f in fs if f.severity == "error"] == []
+    assert "degraded_normalized" in capsys.readouterr().err
+
+
+def test_driver_flags_parse_allow_degraded():
+    # every driver parser must plumb --allow-degraded through to its
+    # config (cnn via FFConfig.from_args; lm / nmt via their parsers)
+    assert FFConfig.from_args(["--allow-degraded"]).allow_degraded
+    from flexflow_tpu.apps.lm import parse_args as lm_parse
+    from flexflow_tpu.apps.nmt import parse_args as nmt_parse
+
+    assert lm_parse(["--allow-degraded"]).allow_degraded
+    assert nmt_parse(["--allow-degraded"]).allow_degraded
+
+
+def test_op_findings_uses_candidate_grid(alexnet8, machine8):
+    # the divisibility check must judge the CANDIDATE pc, not the op's
+    # currently-installed grid
+    op = {o.name: o for o in alexnet8.layers}["linear2"]
+    fs = op_findings(op, ParallelConfig((5, 1), (0, 1, 2, 3, 4)),
+                     machine8)
+    assert "divisibility" in _codes(fs)
+    assert op_findings(op, ParallelConfig((4, 1), (0, 1, 2, 3)),
+                       machine8) == []

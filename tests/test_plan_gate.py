@@ -1,0 +1,117 @@
+"""Search-side plan-legality pre-gate (round 12): StrategySearch checks
+every candidate grid with verify/plan.py candidate_findings BEFORE the
+native simulator sees it, counts the rejections in a ``plan_gate`` obs
+record, and — structurally — never exposes an illegal grid to a sim
+proposal (the MCMC draws only from the per-op candidate lists).
+"""
+
+import logging
+
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.model import FFModel
+from flexflow_tpu.obs import RunLog, read_events
+from flexflow_tpu.sim import search as search_mod
+from flexflow_tpu.sim.search import StrategySearch
+from flexflow_tpu.strategy import ParallelConfig
+
+
+@pytest.fixture(scope="module")
+def machine8():
+    m = MachineModel()
+    if m.num_devices != 8:
+        pytest.skip("gate tests assume the 8-device test mesh")
+    return m
+
+
+def _small_model(machine):
+    cfg = FFConfig(batch_size=16, input_height=16, input_width=16,
+                   num_classes=8)
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((16, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 64, relu=True)
+    ff.softmax("softmax", ff.linear("head", t, 8, relu=False))
+    return ff
+
+
+def _gate_record(tmp_path, machine, run_id):
+    ol = RunLog(str(tmp_path / f"{run_id}.jsonl"), run_id=run_id,
+                surface="search")
+    ss = StrategySearch(_small_model(machine), machine, obs=ol)
+    ol.close()
+    evs = list(read_events(ol.path))
+    (gate,) = [e for e in evs if e["kind"] == "plan_gate"]
+    return ss, gate
+
+
+def test_clean_space_passes_gate(tmp_path, machine8):
+    # candidate_configs only emits grids the executor honors — on the
+    # unmodified generator the gate must reject NOTHING (zero behavior
+    # change vs the pre-gate searcher)
+    ss, gate = _gate_record(tmp_path, machine8, "clean")
+    assert gate["checked"] > 0
+    assert gate["rejected"] == 0 and gate["by_code"] == {}
+    assert gate["ops"] == len(ss.ops)
+
+
+def test_injected_illegal_candidate_rejected(tmp_path, machine8,
+                                             monkeypatch):
+    # an illegal grid smuggled into the candidate list (future
+    # candidate-space widening, warm starts, bugs) is caught by the
+    # gate and NEVER reaches the native simulator: it is absent from
+    # the candidate lists the proposals draw from — that absence IS the
+    # zero-native-sim-invocations guarantee
+    real = search_mod.candidate_configs
+    bad = ParallelConfig((1, 2), (3, 3))        # duplicate device id
+
+    def with_bad(op, num_devices, *a, **kw):
+        cands = real(op, num_devices, *a, **kw)
+        if op.name == "fc":
+            cands = cands + [bad]
+        return cands
+
+    monkeypatch.setattr(search_mod, "candidate_configs", with_bad)
+    ss, gate = _gate_record(tmp_path, machine8, "inject")
+    assert gate["rejected"] == 1
+    assert gate["by_code"] == {"device_dup": 1}
+    assert gate["checked"] > gate["rejected"]
+    for cands in ss.candidates:                  # structural guarantee
+        assert bad not in cands
+
+
+def test_all_illegal_keeps_candidates(tmp_path, machine8, monkeypatch,
+                                      caplog):
+    # when EVERY candidate of an op fails the checker the gate keeps
+    # them all (degraded execution beats an empty search space) and
+    # says so — the keep-all fallback mirrors the HBM filter's
+    bad = ParallelConfig((1, 2), (9, 11))        # out of range
+
+    real = search_mod.candidate_configs
+
+    def only_bad(op, num_devices, *a, **kw):
+        if op.name == "fc":
+            return [bad]
+        return real(op, num_devices, *a, **kw)
+
+    monkeypatch.setattr(search_mod, "candidate_configs", only_bad)
+    with caplog.at_level(logging.WARNING,
+                         logger="flexflow_tpu.sim.search"):
+        ss, gate = _gate_record(tmp_path, machine8, "allbad")
+    assert any("plan checker" in r.getMessage() for r in caplog.records)
+    # kept, not silently dropped: the op still has its candidate
+    fc = next(i for i, op in enumerate(ss.ops) if op.name == "fc")
+    assert ss.candidates[fc] == [bad]
+    # and the keep-all op's rejections are NOT counted as gated-out
+    assert gate["rejected"] == 0
+
+
+@pytest.mark.native
+def test_search_still_converges_with_gate(tmp_path, machine8):
+    ss, _gate = _gate_record(tmp_path, machine8, "conv")
+    strategy, info = ss.search(iters=500, seed=3)
+    assert info["best_time"] > 0
+    assert strategy  # a legal plan came out the other end

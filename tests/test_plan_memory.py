@@ -1,0 +1,129 @@
+"""Per-device HBM-fit prediction (round 12, flexflow_tpu/verify/memory.py)
+cross-checked against XLA's own compiled ``memory_analysis`` — the
+tentpole's calibration requirement: the static prediction must land
+within 25% of the compiled peak (arguments + outputs - aliased +
+temporaries) on real programs, one float32 and one ``--param-dtype
+bfloat16`` (mixed precision: bf16 params + f32 masters + f32 momentum).
+"""
+
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.machine import MachineModel
+from flexflow_tpu.verify.memory import device_memory_report
+
+TOLERANCE = 0.25
+
+
+def _compiled_peak(ff):
+    """The bench.py memory idiom: per-executable compiled footprint."""
+    from flexflow_tpu.data import synthetic_batches
+
+    params, state = ff.init()
+    opt_state = ff.init_opt_state(params)
+    step = ff.make_train_step()
+    img, lbl = next(synthetic_batches(
+        ff.machine, ff.config.batch_size, ff.config.input_height,
+        ff.config.input_width, mode="ones"))
+    mem = step.lower(params, state, opt_state, img, lbl).compile() \
+              .memory_analysis()
+    return (mem.argument_size_in_bytes + mem.output_size_in_bytes
+            - mem.alias_size_in_bytes + mem.temp_size_in_bytes)
+
+
+def _cross_check(param_dtype):
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    machine = MachineModel()
+    if machine.num_devices != 8:
+        pytest.skip("cross-check assumes the 8-device test mesh")
+    ff = build_alexnet(FFConfig(batch_size=64, param_dtype=param_dtype),
+                      machine)
+    report = device_memory_report(ff)
+    predicted = max(d["total"] for d in report["per_device"].values())
+    measured = _compiled_peak(ff)
+    rel_err = (predicted - measured) / measured
+    print(f"plan-memory {param_dtype}: predicted "
+          f"{predicted / 1e9:.3f} GB vs compiled "
+          f"{measured / 1e9:.3f} GB (rel err {rel_err:+.1%})")
+    assert abs(rel_err) <= TOLERANCE, \
+        f"static HBM prediction off by {rel_err:+.1%} (> {TOLERANCE:.0%})"
+    return report
+
+
+def test_prediction_matches_compiled_f32():
+    _cross_check("float32")
+
+
+def test_prediction_matches_compiled_bf16():
+    # mixed precision must NOT change total bytes/param (the 12-byte
+    # invariant): bf16 params+grads save 2x4 bytes, the f32 masters add
+    # 4 back (model.py master_opt_state)
+    report = _cross_check("bfloat16")
+    d0 = report["per_device"][0]
+    # masters + momentum = 4x the bf16 param bytes
+    assert d0["opt"] == pytest.approx(4.0 * d0["params"], rel=1e-6)
+    assert report["assumptions"]["param_dtype"] == "bfloat16"
+
+
+def test_modes_agree_on_total():
+    # f32: 4(param)+4(grad)+4(momentum); bf16: 2+2+8 — same 12 B/param,
+    # so the static totals of the two modes must be (near) identical
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    machine = MachineModel.virtual(8)
+    totals = {}
+    for pd in ("float32", "bfloat16"):
+        ff = build_alexnet(FFConfig(batch_size=64, param_dtype=pd),
+                          machine)
+        rep = device_memory_report(ff)
+        totals[pd] = max(d["total"] for d in rep["per_device"].values())
+    assert totals["float32"] == pytest.approx(totals["bfloat16"],
+                                              rel=0.01)
+
+
+def test_sharded_strategy_reduces_params():
+    # a c-sharded linear stores 1/4 of its kernel per device: the
+    # per-device param account must drop vs pure DP
+    from flexflow_tpu.models.alexnet import build_alexnet
+    from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+    machine = MachineModel.virtual(8)
+    dp = build_alexnet(FFConfig(batch_size=64), machine)
+    base = device_memory_report(dp)["per_device"][0]["params"]
+    s = Strategy()
+    s["linear2"] = ParallelConfig((4, 1), (0, 1, 2, 3))
+    sharded = build_alexnet(FFConfig(batch_size=64, strategies=s),
+                            machine)
+    shard = device_memory_report(sharded, s)["per_device"][0]["params"]
+    # linear2 holds 4096x4096 weights; 3/4 of them leave device 0
+    saved = 0.75 * 4 * 4096 * 4096
+    assert base - shard == pytest.approx(saved, rel=0.05)
+
+
+def test_capacity_and_over_report():
+    from flexflow_tpu.models.alexnet import build_alexnet
+    from flexflow_tpu.verify.memory import format_over_report
+
+    machine = MachineModel.virtual(8)
+    ff = build_alexnet(FFConfig(batch_size=64), machine)
+    rep = device_memory_report(ff, hbm_capacity=1e6)
+    assert len(rep["over"]) == 8  # every device blows a 1 MB budget
+    text = format_over_report(rep)
+    assert "device" in text
+    ok = device_memory_report(ff)  # real HBM: alexnet fits comfortably
+    assert ok["over"] == []
+    assert ok["capacity"] > 1e10
+
+
+def test_donation_credit():
+    # donated=False models a non-donating step: params+opt are held
+    # twice (old + new) and the total must grow by exactly that
+    from flexflow_tpu.models.alexnet import build_alexnet
+
+    machine = MachineModel.virtual(8)
+    ff = build_alexnet(FFConfig(batch_size=64), machine)
+    with_d = device_memory_report(ff)["per_device"][0]
+    without = device_memory_report(ff, donated=False)["per_device"][0]
+    assert without["total"] - with_d["total"] == pytest.approx(
+        with_d["params"] + with_d["opt"], rel=1e-6)

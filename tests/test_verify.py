@@ -332,7 +332,7 @@ def test_pipeline_block_strategy_lowers_and_audits(machine8, tmp_path):
     from flexflow_tpu.strategy import Strategy
 
     s = Strategy()
-    s.pipeline = {"stages": 2, "microbatches": 4, "tp": 1}
+    s.pipeline = {"stages": 2, "microbatches": 2, "tp": 1}
     path = str(tmp_path / "pp.json")
     s.save(path)
     audit = audit_in_process("transformer", 8, 4, path,
@@ -407,7 +407,7 @@ def test_lint_cli_full_pass_on_small_transformer(tmp_path, capsys,
     from flexflow_tpu.strategy import Strategy
 
     s = Strategy()
-    s.pipeline = {"stages": 2, "microbatches": 4, "tp": 1}
+    s.pipeline = {"stages": 2, "microbatches": 2, "tp": 1}
     spath = str(tmp_path / "pp.json")
     s.save(spath)
     # the default exemption file is tuned to the make-lint (alexnet)

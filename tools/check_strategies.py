@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Every committed examples/strategies/*.json must pass the static plan
+checker (flexflow_tpu/verify/plan.py) — clean, or with a reasoned
+exemption in flexflow_tpu/verify/exemptions.json (ids are
+``plan:<code>:<file.json>:<where>``, same policy as ``apps.lint``).
+
+Wired into ``make check``: a strategy artifact that drifts out of
+legality (op renamed, grid no longer dividing, device list outgrowing
+the machine it was searched on) fails CI here instead of failing the
+first user who passes it to a driver.
+
+Model and machine are inferred from the filename: the prefix picks the
+builder (nmt_*, transformer_*, moe_*, alexnet_*, ...), the device count
+is max device id + 1 across the file's entries (strategies are searched
+on contiguous machines, device 0 upward).  Calibration/summary/cache
+artifacts in the same directory are not strategies and are skipped.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:           # runnable as `python tools/...`
+    sys.path.insert(0, REPO)
+STRATEGY_DIR = os.path.join(REPO, "examples", "strategies")
+
+# non-strategy artifacts living in examples/strategies/
+SKIP = {"calibration.json", "dcn_calibration.json", "summary.json"}
+SKIP_PREFIXES = ("measured_cache_",)
+
+# filename prefix -> model name understood by apps.search.build_model
+MODEL_PREFIXES = [
+    ("nmt", "nmt"),
+    ("moe", "moe"),
+    ("transformer", "transformer"),
+    ("gpt", "gpt"),
+    ("bert", "bert"),
+    ("bench_inception", "inception"),
+    ("inception", "inception"),
+    ("alexnet", "alexnet"),
+    ("densenet", "densenet121"),
+    ("resnet", "resnet101"),
+    ("vgg", "vgg16"),
+]
+
+
+def infer_model(fname: str):
+    for prefix, model in MODEL_PREFIXES:
+        if fname.startswith(prefix):
+            return model
+    return None
+
+
+def infer_devices(strategy) -> int:
+    top = 0
+    for pc in strategy.values():
+        if pc.devices:
+            top = max(top, max(pc.devices))
+    return max(top + 1, 1)
+
+
+def build_shadow(model_name: str, machine):
+    """The same builders the drivers use, WITHOUT the strategy (the plan
+    checker vets the file against the clean graph)."""
+    if model_name == "moe":
+        from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                     TransformerLM)
+
+        return TransformerLM(TransformerConfig(num_experts=4,
+                                               batch_size=64), machine)
+    from flexflow_tpu.apps.search import build_model
+
+    # batch 64: the searcher/bench default these artifacts were emitted
+    # at — the pipeline-block microbatch checks are batch-relative
+    return build_model(model_name, machine, batch_size=64)
+
+
+def check_file(path: str, exemptions) -> tuple:
+    """(errors, warnings, skipped_reason) for one strategy file."""
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.verify.findings import apply_exemptions
+    from flexflow_tpu.verify.plan import (plan_findings,
+                                          strategy_file_findings)
+
+    fname = os.path.basename(path)
+    model_name = infer_model(fname)
+    if model_name is None:
+        return [], [], f"no model prefix matches {fname!r}"
+    findings, strategy = strategy_file_findings(path)
+    if strategy is not None:
+        machine = MachineModel.virtual(infer_devices(strategy))
+        shadow = build_shadow(model_name, machine)
+        fs, _ = plan_findings(shadow, strategy, machine,
+                              where_prefix=f"{fname}:")
+        findings += fs
+    findings, _unused = apply_exemptions(findings, exemptions)
+    live = [f for f in findings if not f.exempted]
+    return ([f for f in live if f.severity == "error"],
+            [f for f in live if f.severity == "warning"], None)
+
+
+def main(argv=None, log=print) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    paths = argv or sorted(glob.glob(os.path.join(STRATEGY_DIR, "*.json")))
+    from flexflow_tpu.verify.findings import load_exemptions
+
+    exemptions = load_exemptions(
+        os.path.join(REPO, "flexflow_tpu", "verify", "exemptions.json"))
+    checked, skipped, bad = 0, 0, 0
+    for path in paths:
+        fname = os.path.basename(path)
+        if fname in SKIP or fname.startswith(SKIP_PREFIXES):
+            skipped += 1
+            continue
+        errors, warnings, reason = check_file(path, exemptions)
+        if reason:
+            log(f"check_strategies: SKIP {fname}: {reason}")
+            skipped += 1
+            continue
+        checked += 1
+        for f in warnings:
+            log(f"check_strategies: warning {f.ident()}: {f.message}")
+        for f in errors:
+            log(f"check_strategies: ERROR {f.ident()}: {f.message}")
+        if errors:
+            bad += 1
+    if checked == 0:
+        log("check_strategies: FAIL — no strategy files checked "
+            f"(looked in {STRATEGY_DIR})")
+        return 1
+    if bad:
+        log(f"check_strategies: FAIL — {bad}/{checked} strategy file(s) "
+            f"with plan errors (exempt them in "
+            f"flexflow_tpu/verify/exemptions.json with a reason, id "
+            f"plan:<code>:<file>:<where>)")
+        return 1
+    log(f"check_strategies ok: {checked} strategy file(s) pass the plan "
+        f"checker ({skipped} non-strategy artifact(s) skipped)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,12 @@ pinned subset in pure stdlib:
 * **F401** — unused module-level imports (``# noqa`` on the import
   line opts out; ``__init__.py`` re-exports are exempt, matching the
   per-file-ignores in ruff.toml);
-* **F811** — duplicate top-level def/class bindings.
+* **F811** — duplicate top-level def/class bindings;
+* **F841** — local variables assigned but never read (plain ``name =``
+  and ``except ... as name`` bindings; ``_``-prefixed names are the
+  intentional-discard convention and exempt, as is ``# noqa``);
+* **B006** — mutable literals (list/dict/set/comprehension) as function
+  argument defaults — shared across calls, the classic aliasing trap.
 
 Either way the gate is the same: findings print as ``file:line code
 message`` and the exit status is 1 iff any exist.
@@ -69,6 +74,79 @@ def _used_names(tree) -> set:
     return used
 
 
+def _scope_nodes(func):
+    """Nodes of ``func``'s own scope — nested function/lambda/class
+    bodies are their own scopes (walked in their own pass)."""
+    stack = [func]
+    while stack:
+        node = stack.pop()
+        if node is not func and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_function(func, lines, rel, findings) -> None:
+    """F841 (unused local) + B006 (mutable default) for one function."""
+    def clean(lineno):
+        return lineno <= len(lines) and "noqa" not in lines[lineno - 1]
+
+    # loads ANYWHERE under the function count as uses — a closure
+    # reading the name from a nested def keeps it alive; augmented
+    # assignment both reads and binds (pyflakes parity)
+    loads = {n.id for n in ast.walk(func)
+             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+    loads |= {n.target.id for n in ast.walk(func)
+              if isinstance(n, ast.AugAssign)
+              and isinstance(n.target, ast.Name)}
+    declared = set()
+    for n in ast.walk(func):
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            declared.update(n.names)
+
+    def unused(name):
+        return (name not in loads and name not in declared
+                and not name.startswith("_"))
+
+    for node in _scope_nodes(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if unused(name) and clean(node.lineno):
+                findings.append(
+                    f"{rel}:{node.lineno} F841 local variable {name!r} "
+                    f"is assigned to but never used")
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            name = node.target.id
+            if unused(name) and clean(node.lineno):
+                findings.append(
+                    f"{rel}:{node.lineno} F841 local variable {name!r} "
+                    f"is assigned to but never used")
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            handler_loads = {n.id for n in ast.walk(node)
+                             if isinstance(n, ast.Name)
+                             and isinstance(n.ctx, ast.Load)}
+            if node.name not in handler_loads \
+                    and not node.name.startswith("_") \
+                    and clean(node.lineno):
+                findings.append(
+                    f"{rel}:{node.lineno} F841 local variable "
+                    f"{node.name!r} is assigned to but never used")
+    mutable = (ast.List, ast.Dict, ast.Set,
+               ast.ListComp, ast.DictComp, ast.SetComp)
+    defaults = list(func.args.defaults) + [
+        d for d in func.args.kw_defaults if d is not None]
+    for d in defaults:
+        if isinstance(d, mutable) and clean(d.lineno):
+            findings.append(
+                f"{rel}:{d.lineno} B006 mutable default argument in "
+                f"{func.name!r} (shared across calls; default to None "
+                f"and build inside)")
+
+
 def _check_file(path: str, rel: str, findings) -> None:
     src = open(path).read()
     try:
@@ -107,6 +185,9 @@ def _check_file(path: str, rel: str, findings) -> None:
                     f"{rel}:{stmt.lineno} F811 redefinition of "
                     f"{stmt.name!r} (first at line {seen[stmt.name]})")
             seen.setdefault(stmt.name, stmt.lineno)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_function(node, lines, rel, findings)
 
 
 def main(argv=None) -> int:
@@ -133,8 +214,8 @@ def main(argv=None) -> int:
         print(f"repo_lint: {len(findings)} finding(s) over {n} files")
         return 1
     print(f"repo_lint ok: {n} python files clean "
-          f"(builtin E9/F401/F811 subset; install ruff for the full "
-          f"pinned set)")
+          f"(builtin E9/F401/F811/F841/B006 subset; install ruff for "
+          f"the full pinned set)")
     return 0
 
 
