@@ -2,7 +2,7 @@
 PYTHON ?= python
 
 .PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
-	budget-smoke elastic-smoke preempt-smoke rejoin-smoke
+	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -16,12 +16,39 @@ native:
 # every emitted obs record kind must be rendered by obs/report.py and
 # covered by a test (tools/check_obs_kinds.py), and the static strategy
 # verifier must come up clean (lint)
-check: lint
+check: lint fusion-smoke
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
 	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_strategies.py
 	$(MAKE) -C flexflow_tpu/native check
+
+# per-fusion residual account smoke (round 13, jax-free): `report
+# fusions` against the committed roofline profiles must uphold the
+# account invariants — rows + unattributed sum to the compute residual
+# within 1%, every top-10 row verdicted (no unknowns), stable JSON
+# schema — and the two shipped consumers (add_any -> grad_fanout,
+# select_and_scatter -> pallas maxpool backward) must carry recorded
+# roofline-predicted savings
+fusion-smoke:
+	$(PYTHON) -m flexflow_tpu.apps.report fusions \
+	examples/profiles/inception_v3_roofline.json \
+	examples/profiles/alexnet_roofline.json --json \
+	| $(PYTHON) -c "import json,sys; d=json.loads(sys.stdin.read()); \
+	assert d['violations'] == [], d['violations']; \
+	a = d['accounts'][0]; \
+	assert a['schema'] == 'fusion_account_v1', a['schema']; \
+	assert abs(sum(r['excess_ms'] for r in a['rows']) \
+	+ a['unattributed_ms'] - a['residual_ms']) \
+	<= 0.01 * a['residual_ms'], 'rows do not sum to residual'; \
+	assert all(r['verdict'] in ('fusable','pallas_worthy','irreducible') \
+	for acc in d['accounts'] for r in acc['rows']), 'unverdicted row'; \
+	kinds = {r.get('kernel') or r.get('rewrite') for acc in d['accounts'] \
+	for r in acc['rows'] if r.get('predicted_win_ms') is not None}; \
+	assert {'pallas_maxpool_bwd','grad_fanout'} <= kinds, kinds; \
+	print('fusion-smoke ok:', {'residual_ms': round(a['residual_ms'],2), \
+	'top3_frac': round(a['top3_frac'],4), \
+	'unattributed_ms': round(a['unattributed_ms'],2)})"
 
 # static verification (README "Static verification"): repo-wide python
 # lint (ruff when installed, pinned-subset stdlib fallback otherwise)
@@ -60,10 +87,13 @@ bench-smoke:
 	assert rec['placed_overlap'] == 'on', rec; \
 	assert 'mfu_delta_vs_r05' in rec, rec; \
 	assert 'hlo_fingerprint' in rec, rec; \
+	assert rec.get('donated_bytes', 0) > 0, rec; \
+	assert 'residual_top_frac' in rec \
+	and rec['residual_top_frac'] is not None, rec; \
 	print('bench-smoke ok:', {k: rec[k] for k in \
 	('value','regrid_hops','input_stall_s','comm_frac','stall_frac', \
 	'param_dtype','placed_overlap','mfu_delta_vs_r05', \
-	'hlo_fingerprint')})"
+	'hlo_fingerprint','donated_bytes','residual_top_frac')})"
 
 # deterministic fault-injection smoke (robustness round): loss_nan +
 # data_io injected into a tiny HDF5-fed run with --on-divergence

@@ -186,6 +186,15 @@ def run(model="inception", batch_size=None, iters=10, warmup=3,
         extras["hlo_fingerprint"] = (
             f"{len(hlo_text.splitlines())}:"
             f"{hashlib.sha256(hlo_text.encode()).hexdigest()[:12]}")
+        # donation account (round 13): bytes the step aliases in place,
+        # straight from the executable's input_output_alias header — the
+        # same ground truth the enforcing lint reads.  A donated_bytes
+        # collapse between two metric lines means a buffer fell off the
+        # donation path (and the lint will name it).
+        from flexflow_tpu.verify.donation_lint import donation_summary
+
+        extras["donated_bytes"] = donation_summary(hlo_text)[
+            "donated_bytes"]
         hbm_peak = None
         try:
             stats = machine.devices[0].memory_stats() or {}
@@ -272,6 +281,25 @@ def _bench_record():
             out["mfu_delta_vs_r05"] = round(mfu - r05_mfu, 4)
     except Exception as e:
         print(f"mfu_delta_vs_r05 unavailable: {e}", file=sys.stderr)
+    # round 13: share of the compute residual held by the fusion
+    # auditor's top-3 rows, from the committed roofline profile for the
+    # benched model (None when no fixture exists — the same
+    # key-always-present pattern as mfu_delta_vs_r05).  A shrinking
+    # top-3 share with a flat residual means the big levers were spent
+    # and the tail is next.
+    out["residual_top_frac"] = None
+    try:
+        with open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "examples",
+                "profiles",
+                ("inception_v3" if model == "inception" else model)
+                + "_roofline.json")) as f:
+            profile = json.load(f)
+        from flexflow_tpu.obs.fusions import residual_top_frac
+
+        out["residual_top_frac"] = round(residual_top_frac(profile), 4)
+    except Exception as e:
+        print(f"residual_top_frac unavailable: {e}", file=sys.stderr)
     # the benched strategy's simulated timeline, when the search exported
     # one next to the artifact (apps/search.py -trace writes
     # <stem>.trace.json): its path rides the metric line so the harness

@@ -158,7 +158,10 @@ def _step_passes(opts, findings, summary):
     hlo = step.lower(*inputs).compile().as_text()
     findings += sync_lint.hlo_sync_findings(hlo)
     min_bytes = int(opts["min_donation_mb"] * 1e6)
-    findings += donation_lint.donation_findings(hlo, min_bytes)
+    # enforcing since round 13: a large non-aliased ENTRY param is an
+    # error here, with exemption ids covering the legitimate copies
+    findings += donation_lint.donation_findings(hlo, min_bytes,
+                                                enforce=True)
     summary["donation"] = donation_lint.donation_summary(hlo)
     if opts["steps"] > 0:
         # donation is a no-op on the CPU backend, so feeding outputs

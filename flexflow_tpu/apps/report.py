@@ -221,12 +221,62 @@ def budget_main(argv, log=print) -> int:
     return 0
 
 
+def fusions_main(argv, log=print) -> int:
+    """The per-fusion residual pass (``report fusions``): price each
+    profiled fusion of a roofline profile JSON (utils/hlo_profile
+    roofline_report schema, committed under examples/profiles/) against
+    the chip roofline and print the ranked, verdicted residual account
+    (obs/fusions.py).  Exit 1 when an account violates its sum-to-
+    residual / verdict-coverage invariants."""
+    from flexflow_tpu.obs.fusions import (check_account, fusion_account,
+                                          render_account)
+
+    json_out = "--json" in argv
+    top_n = 10
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--top":
+            i += 1
+            if i >= len(argv):
+                raise SystemExit("flag '--top' expects a value")
+            top_n = int(argv[i])
+        elif not a.startswith("-"):
+            paths.append(a)
+        i += 1
+    if not paths:
+        log(fusions_main.__doc__.strip())
+        return 2
+    accounts, problems = [], []
+    for p in paths:
+        with open(p) as f:
+            profile = json.load(f)
+        if not isinstance(profile, dict) or "top_ops" not in profile:
+            log(f"{p}: not a roofline profile (no top_ops) — run "
+                "utils/hlo_profile.roofline_report / apps/profile first")
+            return 2
+        acct = fusion_account(profile, top_n=top_n)
+        accounts.append(acct)
+        problems += [f"{p}: {m}" for m in check_account(acct)]
+    if json_out:
+        log(json.dumps({"accounts": accounts, "violations": problems}))
+    else:
+        for acct in accounts:
+            log(render_account(acct))
+        if problems:
+            log("ACCOUNT INVARIANT VIOLATED: " + "; ".join(problems))
+    return 1 if problems else 0
+
+
 def main(argv=None, log=print) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "trace":
         return trace_main(argv[1:], log)
     if argv and argv[0] == "budget":
         return budget_main(argv[1:], log)
+    if argv and argv[0] == "fusions":
+        return fusions_main(argv[1:], log)
     json_out = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:

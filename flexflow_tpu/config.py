@@ -10,6 +10,13 @@ from typing import Sequence
 from flexflow_tpu.strategy import Strategy
 
 
+def _checked_pallas(v: str) -> str:
+    """Validate a --pallas value at parse time (like --on-divergence)."""
+    if v not in ("auto", "on", "off"):
+        raise SystemExit(f"--pallas must be auto|on|off, got {v!r}")
+    return v
+
+
 def _checked_policy(v: str) -> str:
     """Validate an --on-divergence value at parse time (like -delta)."""
     if v not in ("halt", "warn", "rollback"):
@@ -172,6 +179,31 @@ class FFConfig:
     # save in flight; fit blocks only on the final save and before a
     # rollback restore.  Off by default — the sync path is unchanged.
     ckpt_async: bool = False
+    # buffer donation (round 13): "on" (default) threads donate_argnums
+    # through every jitted train step — params, optimizer state, and the
+    # mixed-precision __master leaves alias their outputs, so the
+    # steady-state step allocates only the batch and the loss; "off" is
+    # the A/B arm of the bit-identity contract (tests/test_donation.py)
+    # and a debug escape for buffer-reuse investigations.  No CLI flag on
+    # purpose: donation is a compilation property, not a training knob.
+    donate: str = "on"
+    # branch-gradient accumulation (round 13): "tree" (default) hands
+    # each consumer of a multi-consumer tensor its own alias
+    # (ops/fanout.grad_fanout), so the n branch cotangents re-join as
+    # one balanced n-ary sum XLA fuses into a single (n+1)-operand pass
+    # instead of the profile's chain of 2-operand add_any fusions
+    # (3(n-1) -> n+1 HBM traffic units); "off" keeps JAX's pairwise
+    # chain.  Bit-identical for fan-out <= 3, reassociates (tolerance-
+    # level) beyond.  No CLI flag: a compilation property, like donate.
+    grad_fanout: str = "tree"
+    # Pallas kernel policy (round 13): one switch over the per-kernel
+    # env gates (FLEXFLOW_TPU_{FLASH,MAXPOOL,AVGPOOL,BNRELU}, which
+    # still override per-kernel for tests/experiments).  "auto" (the
+    # default) routes a kernel only when its supported() gate holds AND
+    # the HBM cost model predicts a win on the concrete geometry
+    # (ops/pallas/__init__.set_policy); "on" forces every supported
+    # kernel; "off" keeps everything on the stock XLA path.
+    pallas: str = "auto"
     # static plan analyzer (verify/plan.py, round 12): the drivers fail
     # fast on a strategy whose plan check reports errors; --allow-degraded
     # demotes the promoted degradation diagnostics (replicated/normalized
@@ -290,6 +322,8 @@ class FFConfig:
                 cfg.ckpt_async = True
             elif a == "--allow-degraded":
                 cfg.allow_degraded = True
+            elif a in ("-pallas", "--pallas"):
+                cfg.pallas = _checked_pallas(val())
             elif a == "--ckpt-dir":
                 cfg.ckpt_dir = val()
             elif a == "--ckpt-freq":

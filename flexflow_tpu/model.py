@@ -136,6 +136,12 @@ class FFModel:
                  machine: Optional[MachineModel] = None):
         self.config = config or FFConfig()
         self.machine = machine or MachineModel()
+        # install the kernel routing policy (--pallas auto|on|off) before
+        # any op's _use_pallas runs; the per-kernel env vars still
+        # override (ops/pallas/__init__.set_policy)
+        from flexflow_tpu.ops.pallas import set_policy
+
+        set_policy(getattr(self.config, "pallas", "auto") or "auto")
         validate_strategy(self.config.strategies, self.machine.num_devices)
         self.machine = self._permuted_machine_view(self.machine)
         self.layers: List[Op] = []
@@ -1080,6 +1086,11 @@ class FFModel:
         plan = self._regrid_plan_for(fusion, schedule)
         rcache: Dict[Any, Any] = {}
         values: Dict[int, Any] = dict(inputs)
+        # consumer reads go through ``take``: multi-consumer tensors hand
+        # each consumer its own grad_fanout alias so the branch
+        # cotangents re-join as ONE balanced tree sum (ops/fanout.py)
+        # instead of the chained add_any fusions the profile prices
+        take = self._make_value_reader(values, fusion, schedule, train)
         new_state: Dict[str, Dict] = {}
         # tid -> global-mesh entry tuple of each produced value, for
         # decomposing producer->consumer regrids (see _regrid_inputs);
@@ -1108,15 +1119,15 @@ class FFModel:
                         enumerate(zip(entry.members, entry.slots))]
                 if plan is not None:
                     member_inputs = [
-                        [plan.apply(m.name, i, values[t.tid], rcache)
+                        [plan.apply(m.name, i, take(t.tid), rcache)
                          for i, t in enumerate(m.inputs)]
                         for m in entry.members]
                 else:
                     member_inputs = [
                         self._regrid_group_inputs(
-                            entry, m, [values[t.tid] for t in m.inputs],
+                            entry, m, [take(t.tid) for t in m.inputs],
                             specs) if multi else
-                        [values[t.tid] for t in m.inputs]
+                        [take(t.tid) for t in m.inputs]
                         for m in entry.members]
                 outs_by_member, states_by_member = run_group(
                     self.machine, entry,
@@ -1154,10 +1165,10 @@ class FFModel:
                     continue  # projection folded into its loss op
                 values[op.output.tid] = self._run_fused_lm_head(
                     lin, params.get(lin.param_key, {}),
-                    values[lin.inputs[0].tid],
-                    values[op.labels_tensor.tid])
+                    take(lin.inputs[0].tid),
+                    take(op.labels_tensor.tid))
                 continue
-            xs = [values[t.tid] for t in op.inputs]
+            xs = [take(t.tid) for t in op.inputs]
             if multi and plan is not None:
                 xs = [plan.apply(op.name, i, x, rcache)
                       for i, x in enumerate(xs)]
@@ -1181,6 +1192,67 @@ class FFModel:
             if st:
                 new_state[op.name] = st
         return values, new_state
+
+    def _consumer_counts(self, fusion, schedule):
+        """How many times _apply reads each tid, mirroring its control
+        flow exactly (placement groups, folded lm-head fusions, plain
+        ops) — the fan width of _make_value_reader.  Static per plan."""
+        from collections import Counter
+
+        from flexflow_tpu.parallel.placement import PlacementGroup
+
+        counts: Counter = Counter()
+        for entry in schedule:
+            if isinstance(entry, PlacementGroup):
+                for m in entry.members:
+                    for t in m.inputs:
+                        counts[t.tid] += 1
+                continue
+            op = self.layers[entry]
+            if entry in fusion:
+                lin = fusion[entry]
+                if lin is not None:
+                    counts[lin.inputs[0].tid] += 1
+                    counts[op.labels_tensor.tid] += 1
+                continue
+            for t in op.inputs:
+                counts[t.tid] += 1
+        return counts
+
+    def _make_value_reader(self, values, fusion, schedule, train):
+        """The consumer-read accessor for _apply.  With
+        config.grad_fanout = "tree" (and a training trace — eval has no
+        cotangents to accumulate), a tensor with n >= 2 consumers is
+        read as n grad_fanout aliases, one popped per consumer, so the
+        branch cotangents re-join as one balanced n-ary sum
+        (ops/fanout.py) instead of JAX's scattered pairwise add_any
+        chain.  Floating arrays only; everything else reads raw."""
+        if not train or getattr(self.config, "grad_fanout", "tree") \
+                == "off":
+            return values.__getitem__
+        counts = self._consumer_counts(fusion, schedule)
+        if not any(n >= 2 for n in counts.values()):
+            return values.__getitem__
+        import jax.numpy as jnp
+
+        from flexflow_tpu.ops.fanout import grad_fanout
+
+        pending: Dict[int, list] = {}
+
+        def take(tid):
+            n = counts.get(tid, 0)
+            if n < 2:
+                return values[tid]
+            q = pending.get(tid)
+            if q is None:
+                v = values[tid]
+                if not (hasattr(v, "dtype")
+                        and jnp.issubdtype(v.dtype, jnp.floating)):
+                    return v
+                q = pending[tid] = list(grad_fanout(v, n))
+            return q.pop()
+
+        return take
 
     def _regrid_group_inputs(self, entry, m, xs, specs):
         """LEGACY per-trace resharding for a placement-group member's
@@ -1270,6 +1342,14 @@ class FFModel:
         loss = loss_op.loss(values[loss_op.output.tid], labels)
         return loss, new_state
 
+    def _donate(self, argnums):
+        """donate_argnums gated by config.donate — "off" is the A/B arm
+        of the donation bit-identity contract (tests/test_donation.py):
+        aliasing an input buffer to an output must never change a bit of
+        the computed update, only where the update lands."""
+        return argnums if getattr(self.config, "donate", "on") != "off" \
+            else ()
+
     def make_train_step(self):
         """Jitted full training iteration (forward+backward+update)."""
         import jax
@@ -1303,7 +1383,7 @@ class FFModel:
                     self._constrain_state(new_state),
                     self._constrain_params(new_v, psh), loss)
 
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return jax.jit(train_step, donate_argnums=self._donate((0, 1, 2)))
 
     def _make_mixed_train_step(self, lr, wd, mu, cdtype):
         """Master-weight variant of make_train_step (param_dtype !=
@@ -1349,7 +1429,7 @@ class FFModel:
                         new_opt, self._opt_shardings(new_opt, psh)),
                     loss)
 
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return jax.jit(train_step, donate_argnums=self._donate((0, 1, 2)))
 
     def make_sgd_step(self, lr: float):
         """Plain-SGD train step over ``self.loss_fn(params, state, *batch)``
@@ -1374,7 +1454,7 @@ class FFModel:
             return new_params, self._constrain_state(new_state), \
                 opt_state, loss
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        return jax.jit(train_step, donate_argnums=self._donate((0, 1, 2)))
 
     def _make_mixed_sgd_step(self, lr: float):
         """Master-weight variant of make_sgd_step: float32 rate*grad
@@ -1416,7 +1496,7 @@ class FFModel:
             return new_params, self._constrain_state(new_state), \
                 new_opt or opt_state, loss
 
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return jax.jit(train_step, donate_argnums=self._donate((0, 1, 2)))
 
     @staticmethod
     def _lower_step(step, params, state, opt_state, batch):

@@ -1,0 +1,69 @@
+"""Balanced-tree gradient accumulation for multi-consumer tensors.
+
+The Inception profile's #1 vpu residual row is ``add_any`` — when a
+tensor feeds n consumers (every inception block input feeds 4 branch
+stacks), JAX's transpose accumulates the n branch cotangents PAIRWISE at
+the points where they become available, so XLA sees a chain of n-1
+two-operand ``add_any`` fusions scattered across the backward program:
+3(n-1) HBM traffic units (two reads + one write each) for a sum whose
+information content is n+1 units.  ``fusion.22`` alone holds 3.5 ms of
+the 130 ms step (examples/profiles/inception_v3_roofline.json).
+
+:func:`grad_fanout` rewrites the accumulation POINT, not the math: the
+forward hands each consumer its own alias of ``x``, so all n cotangents
+arrive at one ``custom_vjp`` backward, which emits a single balanced
+n-ary tree sum — adjacent adds XLA folds into one (n+1)-operand
+elementwise fusion (one pass: n reads, 1 write).
+
+Numerics: floating addition is commutative but not associative.  The
+balanced tree reduces leftmost-pairs-first, which reproduces JAX's
+left-to-right chain exactly for n <= 3 ((a+b)+c both ways) and
+reassociates for n >= 4 ((a+b)+(c+d) vs ((a+b)+c)+d) — tolerance-level,
+not bit-level, equality there.  FFConfig.grad_fanout = "off" restores
+the stock chain (the A/B arm of tests/test_fanout.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def tree_sum(xs):
+    """Balanced pairwise sum of a non-empty sequence, leftmost pairs
+    first: [a,b,c] -> (a+b)+c, [a,b,c,d] -> (a+b)+(c+d)."""
+    xs = list(xs)
+    if not xs:
+        raise ValueError("tree_sum of no operands")
+    while len(xs) > 1:
+        nxt = [xs[i] + xs[i + 1] for i in range(0, len(xs) - 1, 2)]
+        if len(xs) % 2:
+            nxt.append(xs[-1])
+        xs = nxt
+    return xs[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _fan(n: int):
+    import jax
+
+    @jax.custom_vjp
+    def fan(x):
+        return (x,) * n
+
+    def fwd(x):
+        return (x,) * n, None
+
+    def bwd(_, cts):
+        return (tree_sum(cts),)
+
+    fan.defvjp(fwd, bwd)
+    return fan
+
+
+def grad_fanout(x, n: int):
+    """n aliases of ``x``, one per consumer; their cotangents re-join as
+    ONE balanced tree sum at this point instead of JAX's scattered
+    pairwise chain.  n < 2 is the identity."""
+    if n < 2:
+        return (x,)
+    return _fan(n)(x)

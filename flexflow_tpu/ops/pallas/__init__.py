@@ -9,22 +9,61 @@ flash kernel never materializes in HBM.
 
 Kernels run compiled (Mosaic) on TPU and in interpreter mode elsewhere, so
 the same code path is exercised by the CPU test suite.
+
+Routing policy (round 13): one ``--pallas auto|on|off`` switch
+(:func:`set_policy`, wired from FFConfig by FFModel) replaces ad-hoc
+per-kernel defaults.  ``auto`` routes a kernel only when its
+``supported()`` gate holds AND its HBM cost model predicts a win on the
+concrete geometry (e.g. maxpool.roofline_predicted_win_ms); ``on``
+forces every supported kernel; ``off`` keeps the stock XLA paths.  The
+per-kernel env vars (``FLEXFLOW_TPU_{FLASH,MAXPOOL,AVGPOOL,BNRELU}``
+= 0/1) still override the policy for that one kernel — the test suite's
+and single-experiment escape hatch.
 """
 
 import os
 
 from flexflow_tpu.ops.pallas.flash_attention import flash_attention
 
+_POLICY = "auto"
+
+
+def set_policy(policy: str) -> None:
+    """Install the process-wide kernel routing policy (FFConfig.pallas).
+    Validates eagerly — a typo'd policy fails at model construction, not
+    silently at the first pool."""
+    global _POLICY
+    if policy not in ("auto", "on", "off"):
+        raise ValueError(f"pallas policy must be auto|on|off, "
+                         f"got {policy!r}")
+    _POLICY = policy
+
+
+def get_policy() -> str:
+    return _POLICY
+
+
+def _env_gate(name: str):
+    """Tri-state per-kernel env override: True / False / None (defer to
+    the policy)."""
+    v = os.environ.get(name, "").lower()
+    if v in ("0", "false"):
+        return False
+    if v in ("1", "true"):
+        return True
+    return None
+
 
 def flash_enabled() -> bool:
-    """Policy gate for the flash kernel: on by default on TPU (compiled via
-    Mosaic), off elsewhere (interpret mode is for tests, too slow for
-    training).  FLEXFLOW_TPU_FLASH=0/1 overrides."""
-    env = os.environ.get("FLEXFLOW_TPU_FLASH", "").lower()
-    if env in ("0", "false"):
-        return False
-    if env in ("1", "true"):
-        return True
+    """Policy gate for the flash kernel: under ``auto``, on on TPU
+    (compiled via Mosaic — the measured-win kernel of round 3), off
+    elsewhere (interpret mode is for tests, too slow for training).
+    FLEXFLOW_TPU_FLASH=0/1 overrides."""
+    env = _env_gate("FLEXFLOW_TPU_FLASH")
+    if env is not None:
+        return env
+    if _POLICY != "auto":
+        return _POLICY == "on"
     import jax
 
     return jax.default_backend() == "tpu"
@@ -44,38 +83,57 @@ def tpu_compiler_params():
 
 
 def maxpool_enabled() -> bool:
-    """Policy gate for the Pallas max-pool backward: OFF by default.
-    Per-op it beats XLA's select_and_scatter ~2x (2.9 vs 5.0 ms on
-    Inception's two big pools, compiled-step profile), but end-to-end the
-    swap measures inside the run-to-run jitter band or slightly negative
-    (1926-1942 vs 1946 img/s across three full designs, round 4): the
-    forward sel plane costs a second pass over x that XLA's fused
-    reduce_window pipeline never pays.  Kept opt-in
-    (FLEXFLOW_TPU_MAXPOOL=1) as the measured-evidence answer to the
-    "write the pool kernel" roofline question — see the maxpool module
-    docstring and examples/profiles/README.md."""
-    return os.environ.get("FLEXFLOW_TPU_MAXPOOL", "").lower() \
-        in ("1", "true")
+    """Candidacy gate for the Pallas max-pool backward.  Per-op it beats
+    XLA's select_and_scatter ~2x (2.9 vs 5.0 ms on Inception's two big
+    pools, compiled-step profile), but end-to-end the swap measures
+    inside the run-to-run jitter band or slightly negative (1926-1942 vs
+    1946 img/s across three full designs, round 4): the forward sel
+    plane costs a second pass over x that XLA's fused reduce_window
+    pipeline never pays.  Under ``auto`` the kernel is therefore only a
+    CANDIDATE on TPU — Pool2D._use_pallas makes the final call with
+    maxpool.roofline_predicted_win_ms on the concrete geometry, which
+    prices that sel pass honestly.  ``on`` / FLEXFLOW_TPU_MAXPOOL=1
+    force every supported geometry (the measurement escape)."""
+    env = _env_gate("FLEXFLOW_TPU_MAXPOOL")
+    if env is not None:
+        return env
+    if _POLICY != "auto":
+        return _POLICY == "on"
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def maxpool_cost_gated() -> bool:
+    """True when the routing decision should consult the per-geometry
+    cost model (policy ``auto`` with no env override); forced modes
+    route every supported geometry unconditionally."""
+    return _env_gate("FLEXFLOW_TPU_MAXPOOL") is None and _POLICY == "auto"
 
 
 def avgpool_enabled() -> bool:
     """Policy gate for the Pallas avg-pool backward (ops/pallas/avgpool
-    .py — non-overlapping/global geometries only): OFF by default, opt-in
-    FLEXFLOW_TPU_AVGPOOL=1.  An attribution candidate from the MFU
-    waterfall's per-op residue pending an end-to-end TPU measurement —
-    the maxpool experience (per-op 2x, end-to-end jitter-band) sets the
-    evidence bar for flipping a kernel default."""
-    return os.environ.get("FLEXFLOW_TPU_AVGPOOL", "").lower() \
-        in ("1", "true")
+    .py — non-overlapping/global geometries only).  No measured or
+    modeled win yet (the maxpool experience — per-op 2x, end-to-end
+    jitter-band — sets the evidence bar), so ``auto`` keeps it OFF;
+    ``on`` / FLEXFLOW_TPU_AVGPOOL=1 force it."""
+    env = _env_gate("FLEXFLOW_TPU_AVGPOOL")
+    if env is not None:
+        return env
+    return _POLICY == "on"
 
 
 def bnrelu_enabled() -> bool:
     """Policy gate for the fused batchnorm-normalize+ReLU kernel pair
-    (ops/pallas/bn_act.py): OFF by default, opt-in FLEXFLOW_TPU_BNRELU=1.
-    Same pending-measurement status as avgpool_enabled."""
-    return os.environ.get("FLEXFLOW_TPU_BNRELU", "").lower() \
-        in ("1", "true")
+    (ops/pallas/bn_act.py): same pending-measurement status as
+    avgpool_enabled — ``auto`` keeps it off, ``on`` /
+    FLEXFLOW_TPU_BNRELU=1 force it."""
+    env = _env_gate("FLEXFLOW_TPU_BNRELU")
+    if env is not None:
+        return env
+    return _POLICY == "on"
 
 
 __all__ = ["avgpool_enabled", "bnrelu_enabled", "flash_attention",
-           "flash_enabled", "maxpool_enabled", "tpu_compiler_params"]
+           "flash_enabled", "get_policy", "maxpool_cost_gated",
+           "maxpool_enabled", "set_policy", "tpu_compiler_params"]

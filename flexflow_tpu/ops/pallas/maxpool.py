@@ -78,6 +78,51 @@ def _out_dim(size, k, p):
     return 1 + (size + 2 * p - k) // 2
 
 
+# XLA's select_and_scatter runs "raw" (unvectorized): measured 5.0 ms on
+# Inception's two big pools vs this kernel's 2.9 ms backward.  Expressed
+# against the byte volumes below (XLA bwd moves 2.25x the input plane,
+# the kernel bwd 1.5x), that A/B puts the raw path at ~1.15x the
+# kernel's achieved bytes/s deficit — the calibration constant of the
+# predictor.  (5.0/2.9) * (1.5/2.25) = 1.149.
+_XLA_RAW_PENALTY = 1.15
+
+
+def roofline_predicted_win_ms(n, h, w, c, kh, ph, dtype_bytes=2,
+                              perf=None) -> float:
+    """Predicted end-to-end win (ms, positive = kernel faster) of
+    routing one pool layer through the Pallas backward, from the HBM
+    roofline — the per-geometry cost model behind ``--pallas auto``
+    (Pool2D._use_pallas), replacing the old ``min(h, w) >= 48`` guess.
+
+    Honest accounting of BOTH sides of the measured round-4 trade:
+
+    * XLA backward (select_and_scatter): reads x and dy, writes dx —
+      ``2*x + dy`` bytes, at the raw-class bandwidth deficit
+      (``_XLA_RAW_PENALTY``, calibrated from the 5.0 vs 2.9 ms A/B).
+    * Kernel path: backward reads dy + sel and writes dx, PLUS the
+      forward sel plane costs one extra pass over x (read x, write a
+      bf16 sel) that XLA's fused reduce_window pipeline never pays —
+      the term that made the end-to-end swap measure jitter-band
+      neutral despite the 2x per-op win.
+
+    With both sides priced, stride-2 pools come out slightly negative
+    (the recorded measurement), so ``auto`` correctly declines what
+    ``on`` can still force for measurement runs."""
+    if perf is None:
+        from flexflow_tpu.sim.cost_model import TpuChipPerf
+
+        perf = TpuChipPerf()
+    oh, ow = _out_dim(h, kh, ph), _out_dim(w, kh, ph)
+    x_b = float(n * h * w * c * dtype_bytes)
+    dy_b = float(n * oh * ow * c * dtype_bytes)
+    sel_b = float(n * oh * ow * c * 2)          # sel is bf16 by design
+    bw = perf.hbm_bandwidth
+    xla_ms = (2 * x_b + dy_b) / bw * 1e3 * _XLA_RAW_PENALTY
+    kernel_ms = (dy_b + sel_b + x_b) / bw * 1e3 \
+        + (x_b + sel_b) / bw * 1e3              # fwd sel-plane pass
+    return xla_ms - kernel_ms
+
+
 def _offsets(kh, kw, ph, pw):
     """Static per-window-offset geometry: rank in window iteration order,
     the (row-pair shift, row parity) and (col shift, col parity) of input

@@ -226,14 +226,28 @@ class Pool2D(Op):
                     and avg_ok(self.kernel_h, self.kernel_w, self.stride_h,
                                self.stride_w, self.padding_h, self.padding_w,
                                h, w))
-        from flexflow_tpu.ops.pallas import maxpool_enabled
-        from flexflow_tpu.ops.pallas.maxpool import supported
+        from flexflow_tpu.ops.pallas import (maxpool_cost_gated,
+                                             maxpool_enabled)
+        from flexflow_tpu.ops.pallas.maxpool import (
+            roofline_predicted_win_ms, supported)
 
-        return (maxpool_enabled()
+        if not (maxpool_enabled()
                 and supported(self.kernel_h, self.kernel_w, self.stride_h,
-                              self.stride_w, self.padding_h, self.padding_w,
-                              self.pool_type)
-                and min(h, w) >= 48)
+                              self.stride_w, self.padding_h,
+                              self.padding_w, self.pool_type)):
+            return False
+        if maxpool_cost_gated():
+            # --pallas auto: the per-geometry HBM roofline predictor
+            # replaces the old min(h, w) >= 48 size guess — route only
+            # when pricing BOTH the backward win and the forward
+            # sel-plane pass comes out ahead
+            nb, hb, wb, cb = self.inputs[0].shape
+            from flexflow_tpu.sim.cost_model import dtype_bytes as _db
+
+            return roofline_predicted_win_ms(
+                nb, hb, wb, cb, self.kernel_h, self.padding_h,
+                _db(str(self.inputs[0].dtype))) > 0.0
+        return True
 
     def forward(self, params, state, xs: List, train: bool):
         import jax

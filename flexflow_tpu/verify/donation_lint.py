@@ -61,11 +61,19 @@ def parse_donated_params(hlo: str) -> set:
 
 
 def donation_findings(hlo: str, min_bytes: int = 1 << 20,
-                      label: str = "step") -> List[Finding]:
+                      label: str = "step",
+                      enforce: bool = False) -> List[Finding]:
     """Flag non-donated entry parameters of at least ``min_bytes`` whose
     (dtype, dims) matches an output element not already claimed by a
     donated buffer — the updated-but-copied case.  Non-matching large
-    inputs (the batch) are reported at info level only."""
+    inputs (the batch) are reported at info level only — unless
+    ``enforce`` (round 13, ``make lint``): there EVERY large non-aliased
+    entry param is an error, so a new un-donated buffer breaks the build
+    and the few legitimate copies carry exemption ids.  Enforced
+    large_input loci are keyed by SHAPE (``step:f32[2,224,224,3]``) not
+    param position, so an exemption names the actual buffer it approves
+    and survives parameter reordering instead of silently shifting to a
+    different tensor."""
     params, outputs = parse_entry_shapes(hlo)
     donated = parse_donated_params(hlo)
     # output shape budget: donated params consume their matching output
@@ -94,11 +102,15 @@ def donation_findings(hlo: str, min_bytes: int = 1 << 20,
                 f"aliasing"))
         else:
             out.append(Finding(
-                "donation", "large_input", "info",
-                f"{label}:param{i}",
+                "donation", "large_input",
+                "error" if enforce else "info",
+                f"{label}:{dt}[{dims}]" if enforce
+                else f"{label}:param{i}",
                 f"entry param {i} ({name}: {dt}[{dims}], "
                 f"{size / 1e6:.1f} MB) is not donated (no matching "
-                f"output shape — likely a batch input)"))
+                f"output shape — likely a batch input)"
+                + (" — exempt the shape or donate it" if enforce
+                   else "")))
     return out
 
 

@@ -1,0 +1,224 @@
+"""End-to-end buffer donation (round 13).
+
+Every jitted train step threads ``donate_argnums`` over (params, state,
+opt_state) — including the mixed-precision f32 ``__master`` leaves — so
+the steady-state step's only fresh allocations are the batch and the
+loss.  The contract these tests pin down: donation changes WHERE the
+update lands, never a bit of WHAT is computed (``FFConfig.donate`` =
+"off" is the A/B arm); checkpoint resume and elastic ``place_state``
+migration keep working against donated buffers; and the compiled ENTRY's
+``input_output_alias`` header actually claims params + opt state +
+masters.  The enforcing lint mode (verify/donation_lint.py
+``enforce=True``, wired into ``make lint``) turns any OTHER large
+non-aliased entry param into a build failure with a shape-keyed locus.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.model import _MASTER_SUFFIX, FFModel
+from flexflow_tpu.verify import donation_lint
+
+
+def _model(machine, donate="on", param_dtype="float32", tmp=None,
+           ckpt_freq=0, iters=6, momentum=0.0):
+    cfg = FFConfig(batch_size=8, input_height=16, input_width=16,
+                   num_iterations=iters, print_freq=0, num_classes=8,
+                   seed=7, donate=donate, param_dtype=param_dtype,
+                   momentum=momentum, ckpt_dir=str(tmp) if tmp else "",
+                   ckpt_freq=ckpt_freq)
+    ff = FFModel(cfg, machine)
+    img = ff.create_input((8, 16, 16, 3), name="image")
+    t = ff.conv2d("conv1", img, 8, 3, 3, 1, 1, 1, 1, relu=True)
+    t = ff.batch_norm("bn1", t, relu=True)
+    t = ff.flat("flat", t)
+    t = ff.linear("fc", t, 8, relu=False)
+    ff.softmax("softmax", t)
+    return ff
+
+
+def _data(machine):
+    from flexflow_tpu.data import synthetic_batches
+
+    return synthetic_batches(machine, 8, 16, 16, num_classes=8,
+                             mode="random", seed=7)
+
+
+def _step_hlo(ff):
+    params, state = ff.init()
+    opt = ff.init_opt_state(params)
+    batch = next(iter(_data(ff.machine)))
+    step = ff.make_train_step()
+    return step.lower(params, state, opt, *batch).compile().as_text()
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: donation must not change a single computed bit
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+@pytest.mark.parametrize("param_dtype", ["float32", "bfloat16"])
+def test_donation_on_off_bit_identical_losses(machine8, momentum,
+                                              param_dtype):
+    on = _model(machine8, donate="on", param_dtype=param_dtype,
+                momentum=momentum).fit(_data(machine8),
+                                       log=lambda *a: None)
+    off = _model(machine8, donate="off", param_dtype=param_dtype,
+                 momentum=momentum).fit(_data(machine8),
+                                        log=lambda *a: None)
+    assert len(on["loss"]) == 6 and all(np.isfinite(on["loss"]))
+    # EXACT equality, not approx: donation only renames buffers
+    assert on["loss"] == off["loss"]
+
+
+def test_donate_off_compiles_without_aliases(machine1):
+    hlo = _step_hlo(_model(machine1, donate="off"))
+    assert donation_lint.parse_donated_params(hlo) == set()
+
+
+# ---------------------------------------------------------------------------
+# the compiled ENTRY donates params + opt state + masters
+
+
+@pytest.mark.parametrize("param_dtype", ["float32", "bfloat16"])
+def test_entry_aliases_params_opt_and_masters(machine1, param_dtype):
+    hlo = _step_hlo(_model(machine1, param_dtype=param_dtype,
+                           momentum=0.9))
+    # nothing updated-but-copied survives at any size threshold...
+    assert donation_lint.first_nondonated(hlo, min_bytes=1) is None
+    summ = donation_lint.donation_summary(hlo)
+    # ...and the only non-donated entry params are the batch (image +
+    # labels); params, momentum, and (bf16) the f32 masters all alias
+    assert summ["params"] - summ["donated"] == 2
+    assert summ["donated_bytes"] > 0
+    params, _ = donation_lint.parse_entry_shapes(hlo)
+    donated = donation_lint.parse_donated_params(hlo)
+    sizes = sorted(donation_lint._nbytes(dt, dims)
+                   for i, (_, dt, dims) in enumerate(params)
+                   if i not in donated)
+    # the two non-donated leftovers really are the batch tensors
+    assert sizes == sorted(
+        (8 * 16 * 16 * 3 * 4, 8 * 4))  # f32 image, s32 labels
+
+
+# ---------------------------------------------------------------------------
+# checkpoint resume from a donated run stays bit-exact
+
+
+def test_checkpoint_resume_bit_exact_from_donated_run(tmp_path, machine8):
+    straight = _model(machine8, param_dtype="bfloat16", momentum=0.9).fit(
+        _data(machine8), log=lambda *a: None)
+    part1 = _model(machine8, param_dtype="bfloat16", momentum=0.9,
+                   tmp=tmp_path).fit(
+        _data(machine8), num_iterations=3, log=lambda *a: None)
+    assert part1["loss"] == straight["loss"][:3]
+    resumed = _model(machine8, param_dtype="bfloat16", momentum=0.9,
+                     tmp=tmp_path).fit(_data(machine8),
+                                       log=lambda *a: None)
+    assert resumed["loss"][-1] == straight["loss"][-1]
+
+
+# ---------------------------------------------------------------------------
+# elastic migration: place_state of donated+mixed state across
+# shrink and grow
+
+
+def test_place_state_donated_mixed_across_shrink_and_grow(machine8):
+    import jax
+
+    ff8 = _model(machine8, param_dtype="bfloat16", momentum=0.9)
+    params, state = ff8.init()
+    opt = ff8.init_opt_state(params)
+    # run one donated step so the migrated tree is a step OUTPUT (the
+    # buffers a real elastic event would migrate), not init state
+    batch = next(iter(_data(machine8)))
+    step = ff8.make_train_step()
+    params, state, opt, _ = step(params, state, opt, *batch)
+
+    host = jax.tree.map(np.asarray, (params, state, opt))
+    ff4 = _model(machine8.shrink(range(4)), param_dtype="bfloat16",
+                 momentum=0.9)
+    p4, s4, o4 = ff4.place_state(*host)
+    ffg = _model(machine8, param_dtype="bfloat16", momentum=0.9)
+    pg, sg, og = ffg.place_state(*jax.tree.map(np.asarray, (p4, s4, o4)))
+
+    for shrunk_grown, orig in ((p4, params), (o4, opt), (pg, params),
+                               (og, opt)):
+        for key, sub in shrunk_grown.items():
+            for k, v in sub.items():
+                assert v.dtype == orig[key][k].dtype, (key, k)
+                np.testing.assert_array_equal(np.asarray(v),
+                                              np.asarray(orig[key][k]))
+    assert any(k.endswith(_MASTER_SUFFIX)
+               for sub in og.values() for k in sub)
+    # the re-grown state drives a working donated step
+    pg, sg, og, loss = ffg.make_train_step()(pg, sg, og, *batch)
+    assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# enforcing lint mode (make lint): large non-aliased inputs become
+# errors with shape-keyed loci
+
+
+def _sgd_hlo(donate):
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << 18  # f32[262144] = 1 MiB
+
+    def step(p, x):
+        return p - 0.1 * x, (p * x).sum()
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    return jitted.lower(jnp.ones(n), jnp.ones(n)).compile().as_text()
+
+
+def test_enforce_promotes_large_input_to_shape_keyed_error():
+    hlo = _sgd_hlo(donate=True)
+    fs = donation_lint.donation_findings(hlo, min_bytes=1 << 20,
+                                         enforce=True)
+    assert [f.severity for f in fs] == ["error"]
+    (f,) = fs
+    assert f.code == "large_input"
+    # locus is the SHAPE, not the param position: the exemption id names
+    # the buffer it approves and survives parameter reordering
+    assert f.where == "step:f32[262144]"
+    # default (non-enforcing) severity is unchanged info
+    fs = donation_lint.donation_findings(hlo, min_bytes=1 << 20)
+    assert {f.severity for f in fs} == {"info"}
+
+
+def test_committed_exemption_matches_the_enforced_locus_exactly():
+    """The trimmed exemptions.json entry must be the exact shape-keyed
+    id the enforcing alexnet lint emits — if either drifts, make lint
+    fails (non-exempt error, or unused-exemption error): the
+    stale-exemption property the enforcing mode must keep."""
+    import json
+    import os
+
+    from flexflow_tpu.verify.findings import (Finding, apply_exemptions,
+                                              load_exemptions)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "flexflow_tpu", "verify",
+                        "exemptions.json")
+    ids = [e["id"] for e in json.load(open(path))["exemptions"]]
+    assert "donation:large_input:step:f32[2,224,224,3]" in ids
+    # no wildcard donation exemptions survive the round-13 trim
+    assert not any(i.startswith("donation:") and i.endswith("*")
+                   for i in ids)
+    exemptions = load_exemptions(path)
+    lint_batch = Finding(
+        "donation", "large_input", "error", "step:f32[2,224,224,3]",
+        "entry param is not donated")
+    other_shape = Finding(
+        "donation", "large_input", "error", "step:f32[64,112,112,96]",
+        "entry param is not donated")
+    out, unused = apply_exemptions([lint_batch, other_shape], exemptions)
+    assert out[0].exempted and not out[1].exempted
+    # a lint-model batch-shape change leaves the exemption unused ->
+    # apps/lint turns that into an error for the donation pass
+    _, unused = apply_exemptions([other_shape], exemptions)
+    assert "donation:large_input:step:f32[2,224,224,3]" in unused

@@ -552,3 +552,110 @@ def test_pool2d_routes_through_pallas_when_enabled(monkeypatch):
     assert not op._use_pallas(None)
     y_xla, _ = op.forward({}, {}, [x], train=True)
     np.testing.assert_array_equal(np.asarray(y_pal), np.asarray(y_xla))
+
+
+# ---------------------------------------------------------------------------
+# Round-13 routing policy: one --pallas auto|on|off switch (installed by
+# FFModel from FFConfig.pallas) + the per-geometry maxpool cost model
+# that replaces the old min(h, w) >= 48 size guess under auto.
+
+
+def test_set_policy_validates_eagerly():
+    from flexflow_tpu.ops import pallas
+
+    before = pallas.get_policy()
+    with pytest.raises(ValueError):
+        pallas.set_policy("sometimes")
+    assert pallas.get_policy() == before
+
+
+def test_policy_forced_modes(monkeypatch):
+    from flexflow_tpu.ops import pallas
+
+    for var in ("FLEXFLOW_TPU_FLASH", "FLEXFLOW_TPU_MAXPOOL",
+                "FLEXFLOW_TPU_AVGPOOL", "FLEXFLOW_TPU_BNRELU"):
+        monkeypatch.delenv(var, raising=False)
+    try:
+        pallas.set_policy("on")
+        assert pallas.flash_enabled() and pallas.maxpool_enabled()
+        assert pallas.avgpool_enabled() and pallas.bnrelu_enabled()
+        assert not pallas.maxpool_cost_gated()  # forced: no cost model
+        pallas.set_policy("off")
+        assert not (pallas.flash_enabled() or pallas.maxpool_enabled()
+                    or pallas.avgpool_enabled() or pallas.bnrelu_enabled())
+        pallas.set_policy("auto")
+        # CPU backend: TPU-candidate kernels off, pending-measurement
+        # kernels (avgpool/bnrelu) off by design until a TPU run says so
+        assert not pallas.maxpool_enabled()
+        assert not pallas.avgpool_enabled()
+        assert pallas.maxpool_cost_gated()
+    finally:
+        pallas.set_policy("auto")
+
+
+def test_env_vars_override_policy_per_kernel(monkeypatch):
+    from flexflow_tpu.ops import pallas
+
+    try:
+        pallas.set_policy("off")
+        monkeypatch.setenv("FLEXFLOW_TPU_MAXPOOL", "1")
+        assert pallas.maxpool_enabled()          # env beats policy off
+        assert not pallas.maxpool_cost_gated()   # explicit = no gate
+        assert not pallas.avgpool_enabled()      # other kernels stay off
+        pallas.set_policy("on")
+        monkeypatch.setenv("FLEXFLOW_TPU_MAXPOOL", "0")
+        assert not pallas.maxpool_enabled()      # env beats policy on
+        assert pallas.bnrelu_enabled()
+    finally:
+        pallas.set_policy("auto")
+
+
+def test_ffmodel_installs_the_policy(machine1):
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.model import FFModel
+    from flexflow_tpu.ops import pallas
+
+    try:
+        FFModel(FFConfig(batch_size=8, input_height=16, input_width=16,
+                         num_classes=8, pallas="off"), machine1)
+        assert pallas.get_policy() == "off"
+    finally:
+        pallas.set_policy("auto")
+
+
+def test_maxpool_cost_model_prices_both_sides():
+    from flexflow_tpu.ops.pallas.maxpool import roofline_predicted_win_ms
+
+    # Inception's first big pool (2, 147, 147, 64), 3x3/2 pad 0: in f32
+    # the backward byte saving beats the extra forward sel-plane pass...
+    assert roofline_predicted_win_ms(2, 147, 147, 64, 3, 0, 4) > 0
+    # ...in bf16 it does not (x halves, the bf16 sel plane does not) —
+    # reproducing the measured end-to-end neutrality of the naive swap
+    assert roofline_predicted_win_ms(2, 147, 147, 64, 3, 0, 2) < 0
+    # deeper window, same trend but monotone in the input byte volume
+    assert roofline_predicted_win_ms(2, 147, 147, 64, 3, 0, 4) > \
+        roofline_predicted_win_ms(2, 71, 71, 64, 3, 0, 4)
+
+
+def test_pool2d_auto_routes_by_predicted_win(monkeypatch):
+    from flexflow_tpu.ops import pallas
+    from flexflow_tpu.ops.base import Tensor
+    from flexflow_tpu.ops.pool import Pool2D
+    from flexflow_tpu.strategy import ParallelConfig
+
+    monkeypatch.delenv("FLEXFLOW_TPU_MAXPOOL", raising=False)
+    # stand in for the TPU-backend candidacy so auto reaches the model
+    monkeypatch.setattr(pallas, "maxpool_enabled", lambda: True)
+    try:
+        pallas.set_policy("auto")
+        pc = ParallelConfig((1, 1, 1, 1), (0,))
+        op32 = Pool2D("p32", pc, Tensor((2, 147, 147, 64)), 3, 3, 2, 2,
+                      0, 0, relu=False)
+        assert op32._use_pallas(None)        # f32: predicted win
+        op16 = Pool2D("p16", pc, Tensor((2, 147, 147, 64), "bfloat16"),
+                      3, 3, 2, 2, 0, 0, relu=False)
+        assert not op16._use_pallas(None)    # bf16: predicted loss
+        pallas.set_policy("on")
+        assert op16._use_pallas(None)        # forced mode skips the gate
+    finally:
+        pallas.set_policy("auto")
