@@ -2,7 +2,8 @@
 PYTHON ?= python
 
 .PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
-	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke
+	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke \
+	serve-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -16,7 +17,7 @@ native:
 # every emitted obs record kind must be rendered by obs/report.py and
 # covered by a test (tools/check_obs_kinds.py), and the static strategy
 # verifier must come up clean (lint)
-check: lint fusion-smoke
+check: lint fusion-smoke serve-smoke
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
@@ -134,6 +135,27 @@ preempt-smoke:
 # checkpoint onto the rejoined mesh
 rejoin-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m flexflow_tpu.apps.rejoin_smoke
+
+# serving-runtime smoke (serve/ round): equivalence phase (batching on
+# vs off must give bit-identical replies) + autoscale lifecycle phase
+# (gap-then-burst load: exactly one 8->6 idle shrink and one 6->8
+# queue-depth grow, zero dropped, finite latencies, `report serve`
+# renders the latency histogram from the fresh obs dir); stdout is
+# exactly one JSON record, asserted like bench-smoke
+serve-smoke:
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.serve --smoke \
+	| $(PYTHON) -c "import json,math,sys; \
+	rec=json.loads(sys.stdin.readline()); \
+	assert sys.stdin.readline() == '', 'stdout must be one JSON line'; \
+	assert rec['resizes'] == 2, rec; \
+	assert rec['dropped'] == 0 and rec['unserved'] == 0, rec; \
+	assert math.isfinite(rec['p50_s']) and math.isfinite(rec['p99_s']), rec; \
+	assert rec['completed'] == rec['requests'] > 0, rec; \
+	assert rec['devices'] == 8, rec; \
+	print('serve-smoke ok:', {k: rec[k] for k in \
+	('completed','qps','p50_s','p99_s','resizes','devices')})"
 
 # MFU-waterfall smoke (observability): tiny CNN with sampled op timing +
 # live metrics export; asserts the step_budget bucket invariant, a

@@ -5,6 +5,8 @@
         [-o DIR] [--json]
     python -m flexflow_tpu.apps.report budget <run.jsonl|obs_dir ...> \\
         [--json]
+    python -m flexflow_tpu.apps.report serve <run.jsonl|obs_dir ...> \\
+        [--json]
 
 Default mode renders a run's JSONL event stream (FFConfig.obs_dir /
 RunLog output, a search-trace artifact, or a bench log) into the summary
@@ -37,6 +39,10 @@ roofline, printed as achieved MFU -> bucket-by-bucket recovery -> the
 roofline ceiling, largest lever first.  A bare directory argument (to any
 mode) expands to every ``*.jsonl`` stream inside it, so
 ``report budget <obs_dir>`` works on a fresh obs dir directly.
+
+The ``serve`` subcommand renders a serving run's ``serve_*`` records
+(apps/serve.py -obs-dir): per-request latency histogram + p50/p90/p99,
+batch-occupancy curve, and the queue-driven autoscale resizes.
 """
 
 from __future__ import annotations
@@ -269,6 +275,33 @@ def fusions_main(argv, log=print) -> int:
     return 1 if problems else 0
 
 
+def serve_main(argv, log=print) -> int:
+    """The serving pass (``report serve``): render the latency histogram
+    + percentiles, batch occupancy, and autoscale resizes of a serving
+    run's ``serve_*`` records (apps/serve.py -obs-dir).  Exit 1 when the
+    stream carries no serving records."""
+    from flexflow_tpu.obs.report import _serve_section, summarize
+
+    json_out = "--json" in argv
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        log(serve_main.__doc__.strip())
+        return 2
+    events, _ = _read_paths(paths, log)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    if json_out:
+        s = summarize(events).get("serve")
+        log(json.dumps(s or {}))
+        return 0 if s else 1
+    lines = _serve_section(events)
+    if not lines:
+        log("no serve_* records in the stream(s): run apps/serve.py "
+            "with -obs-dir set")
+        return 1
+    log("\n".join(lines))
+    return 0
+
+
 def main(argv=None, log=print) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "trace":
@@ -277,6 +310,8 @@ def main(argv=None, log=print) -> int:
         return budget_main(argv[1:], log)
     if argv and argv[0] == "fusions":
         return fusions_main(argv[1:], log)
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:], log)
     json_out = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:

@@ -23,6 +23,14 @@ the delta re-simulation: ``on`` (default) prices each proposal in
 on divergence > 1e-9 (debug mode; the accepted sequence is identical in
 all three for a fixed seed).
 
+``--objective makespan|latency`` picks what the simulator prices:
+``makespan`` (default) is the full training step; ``latency`` prices ONE
+forward/decode step from the same native tables (costs / 3, no gradient
+sync, no optimizer stream) for serving-SLO search.  ``--serve`` implies
+``--objective latency`` and stamps a ``__predicted__.serve`` block
+(max_batch, per-device KV-cache bytes, forward_step_s) on the artifact —
+the handoff serve/engine.py and verify/plan.py consume.
+
 ``-trace`` exports the simulated per-op timeline of the FINAL plan and
 the pure-DP baseline as one Chrome/Perfetto ``trace_event`` JSON
 (``<out-stem>.trace.json`` next to ``-o``, else
@@ -60,7 +68,7 @@ def parse_args(argv):
         "ici_group": None, "cache": "", "audit": None,
         "dtype": "float32", "dcn_calibration": "", "experts": 0,
         "obs_dir": "", "run_id": "", "chains": 1, "delta": "on",
-        "trace": False,
+        "trace": False, "objective": None, "serve": False,
     }
     from flexflow_tpu.utils.flags import flag_stream
 
@@ -116,9 +124,26 @@ def parse_args(argv):
             # the pure-DP baseline as a Chrome/Perfetto trace
             # (ffsim_simulate_trace -> obs/trace.py)
             opts["trace"] = True
+        elif a == "--objective":
+            # makespan (default): price the full training step.
+            # latency: price ONE forward/decode step from the same
+            # simulator tables (serving SLO search — sim/search.py)
+            opts["objective"] = val()
+        elif a == "--serve":
+            # emit a SERVING strategy artifact: implies --objective
+            # latency unless one is given, and stamps a __predicted__
+            # serve block (max_batch, per-device KV-cache bytes,
+            # forward_step_s) that serve/engine.py reads for its virtual
+            # clock and verify/plan.py for the forward-only HBM vet
+            opts["serve"] = True
     if opts["delta"] not in ("on", "off", "check"):
         raise SystemExit(f"-delta must be on|off|check, got "
                          f"{opts['delta']!r}")
+    if opts["objective"] is None:
+        opts["objective"] = "latency" if opts["serve"] else "makespan"
+    if opts["objective"] not in ("makespan", "latency"):
+        raise SystemExit(f"--objective must be makespan|latency, got "
+                         f"{opts['objective']!r}")
     return opts
 
 
@@ -266,7 +291,8 @@ def _grounded_accept(opts, machine, model, cost_model, search, strategy,
     log("re-searching with canonical placements only (dims-only) — "
         "subset placement is what defeated the lowering")
     s2 = StrategySearch(model, machine, cost_model=cost_model,
-                        placement=False, obs=search.obs)
+                        placement=False, obs=search.obs,
+                        objective=opts.get("objective", "makespan"))
     strategy2, info2 = s2.search(iters=opts["iters"], seed=opts["seed"],
                                  **_search_kw(opts))
     if info2["speedup_vs_dp"] > 1.05:
@@ -390,7 +416,8 @@ def main(argv=None, log=print) -> dict:
     meta = {"app": "search", "model": opts["model"],
             "devices": machine.num_devices, "iters": opts["iters"],
             "measured": opts["measured"], "seed": opts["seed"],
-            "chains": opts["chains"], "delta": opts["delta"]}
+            "chains": opts["chains"], "delta": opts["delta"],
+            "objective": opts["objective"]}
     if opts["obs_dir"]:
         run_id = opts["run_id"] or _obs.new_run_id()
         olog = _obs.RunLog(
@@ -406,11 +433,12 @@ def main(argv=None, log=print) -> dict:
     from flexflow_tpu.sim.search import StrategySearch
 
     search = StrategySearch(model, machine, cost_model=cost_model,
-                            obs=olog)
+                            obs=olog, objective=opts["objective"])
     strategy, info = search.search(iters=opts["iters"], seed=opts["seed"],
                                    **_search_kw(opts))
     result = {
         "model": opts["model"],
+        "objective": opts["objective"],
         "devices": machine.num_devices,
         "dp_time_s": info["dp_time"],
         "best_time_s": info["best_time"],
@@ -442,14 +470,18 @@ def main(argv=None, log=print) -> dict:
         result["speedup_vs_dp"] = info["speedup_vs_dp"]
         # audit surface: same record schema as everything else
         olog.event("hlo_audit", **audit_info.get("hlo_audit", {}))
-    if opts["model"] in ("transformer", "gpt", "bert"):
+    if opts["model"] in ("transformer", "gpt", "bert") \
+            and opts["objective"] == "makespan":
         # the GPipe scheduler configuration joins the search space for
         # the LM (round 4, VERDICT r3 #5): propose-or-reject a pipeline
         # block with every candidate's cost logged, feasibility-gated on
         # the executor's divisibility rules, accepted only when it beats
         # the best NON-pipelined plan (it replaces the per-op entries in
         # the consuming driver).  NMT is excluded: no NMT driver consumes
-        # the block (PipelinedLM is a transformer stack).
+        # the block (PipelinedLM is a transformer stack).  The latency
+        # objective is excluded too: GPipe schedules the TRAINING step
+        # (fwd+bwd over microbatches); a serving strategy carries no
+        # pipeline block.
         import math as _math
 
         pp = search.propose_pipeline(
@@ -492,7 +524,21 @@ def main(argv=None, log=print) -> dict:
         "speedup_vs_dp": info["speedup_vs_dp"],
         "cost_model": "measured" if opts["measured"] else "analytic",
         "batch_size": opts["batch_size"],
+        "objective": opts["objective"],
     }
+    if opts["serve"]:
+        # the serving block: serve/engine.py reads forward_step_s as its
+        # virtual decode-step time, verify/plan.py charges the KV-cache
+        # bytes against the forward-only per-device HBM peak
+        from flexflow_tpu.serve.kv_cache import kv_cache_bytes
+
+        strategy.predicted["serve"] = {
+            "max_batch": opts["batch_size"],
+            "kv_cache_bytes_per_device": kv_cache_bytes(
+                model, opts["batch_size"], strategy=strategy),
+            "forward_step_s": info["best_time"],
+        }
+        result["serve"] = strategy.predicted["serve"]
     if opts["trace"]:
         result["trace_path"] = _write_sim_trace(opts, search, info, olog,
                                                 log)

@@ -1,0 +1,403 @@
+"""Serving driver — continuous-batching inference with latency-objective
+strategies and queue-driven elastic autoscaling (serve/ package).
+
+    python -m flexflow_tpu.apps.serve gpt --requests 32 --rate-qps 200 \\
+        --max-new-tokens 4 -s serve_strat.json -obs-dir obs/
+    python -m flexflow_tpu.apps.serve --smoke
+
+The transformer family decodes autoregressively with continuous batching
+and the sharded KV cache; CNN/NMT models get the batched forward-only
+service (padded fixed-shape batches through DevicePrefetcher).  A
+``-s``/``--strategy`` artifact — ideally one from ``apps/search.py
+--serve`` (latency objective + ``__predicted__.serve`` block) — is
+vetted by the static plan analyzer (verify/plan.py prices a serving
+strategy forward-only with the KV cache charged) before anything runs.
+
+Autoscaling: ``--serve-idle-boundaries N`` shrinks the mesh to
+``--shrink-to`` devices after N consecutive idle decode boundaries;
+``--serve-queue-hi D`` grows parked devices back when the arrival queue
+reaches depth D.  Each resize re-searches under the latency objective on
+the new mesh (utils/elastic.research_strategy) and live-regrids the
+params.  **Drain contract**: SIGTERM/SIGINT stops admission, the
+in-flight requests finish, queued-but-never-admitted requests are
+reported ``unserved`` (never dropped), and the process EXITS 0.
+
+stdout carries EXACTLY ONE JSON line —
+
+    {"run_id": ..., "qps": ..., "p50_s": ..., "p99_s": ..., "resizes": ...}
+
+(plus completed/unserved/dropped/devices/drained detail) — the same
+single-record contract bench.py holds, asserted by ``make serve-smoke``.
+Everything else (engine narration, resize logs, assertions) goes to
+stderr.  ``--smoke`` runs the deterministic two-phase scenario: batched
+replies must be bit-identical to the same requests served one-at-a-time,
+and a gap-then-burst load must produce exactly one 8->6 shrink and one
+6->8 grow with zero dropped requests and finite latencies.
+
+Telemetry: ``-obs-dir`` streams serve_request / serve_batch /
+serve_resize / serve_summary records (render with ``python -m
+flexflow_tpu.apps.report serve <dir>``); ``-metrics-path`` exports the
+ff_qps / ff_queue_depth / ff_latency_p50_s / ff_latency_p99_s /
+ff_requests_total gauges.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+
+def _err(*a, **kw):
+    print(*a, file=sys.stderr, **kw)
+    sys.stderr.flush()
+
+
+def parse_args(argv):
+    from flexflow_tpu.utils.flags import flag_stream
+
+    opts = {
+        "model": "gpt", "batch_size": 8, "max_batch": 0,
+        "requests": 16, "rate_qps": 100.0, "max_new_tokens": 4,
+        "prompt_len": 4, "seed": 0, "strategy": "", "dtype": "float32",
+        "queue_hi": 0, "idle_boundaries": 0, "shrink_to": 0,
+        "obs_dir": "", "run_id": "", "metrics_path": "",
+        "step_time_s": 0.0, "tiny": False, "smoke": False,
+    }
+    args = list(argv)
+    if args and not args[0].startswith("-"):
+        opts["model"] = args.pop(0)
+    for a, val in flag_stream(args):
+        if a in ("-b", "--batch-size"):
+            opts["batch_size"] = int(val())
+        elif a == "--max-batch":
+            opts["max_batch"] = int(val())
+        elif a in ("-n", "--requests"):
+            opts["requests"] = int(val())
+        elif a == "--rate-qps":
+            opts["rate_qps"] = float(val())
+        elif a == "--max-new-tokens":
+            opts["max_new_tokens"] = int(val())
+        elif a == "--prompt-len":
+            opts["prompt_len"] = int(val())
+        elif a == "--seed":
+            opts["seed"] = int(val())
+        elif a in ("-s", "--strategy"):
+            opts["strategy"] = val()
+        elif a == "--dtype":
+            opts["dtype"] = val()
+        elif a == "--serve-queue-hi":
+            opts["queue_hi"] = int(val())
+        elif a == "--serve-idle-boundaries":
+            opts["idle_boundaries"] = int(val())
+        elif a == "--shrink-to":
+            opts["shrink_to"] = int(val())
+        elif a in ("-obs-dir", "--obs-dir"):
+            opts["obs_dir"] = val()
+        elif a in ("-run-id", "--run-id"):
+            opts["run_id"] = val()
+        elif a in ("-metrics-path", "--metrics-path"):
+            opts["metrics_path"] = val()
+        elif a == "--step-time-s":
+            opts["step_time_s"] = float(val())
+        elif a == "--tiny":
+            opts["tiny"] = True
+        elif a == "--smoke":
+            opts["smoke"] = True
+    return opts
+
+
+def _build_lm(machine, *, batch, seed=0, dtype="float32", strategies=None,
+              research_budget_s=10.0, tiny=False):
+    """A serving TransformerLM plus the elastic rebuild factory that
+    reconstructs it on a resized mesh (the same closure shape apps/lm.py
+    hands fit()).  Default geometry matches apps/search.py's transformer
+    (so a ``--serve`` search artifact names the same ops); ``tiny`` is
+    the smoke's CPU-sized 2-layer GPT."""
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+
+    kw = dict(batch_size=batch, causal=True, seed=seed,
+              compute_dtype=dtype, research_budget_s=research_budget_s)
+    if tiny:
+        kw.update(seq_length=16, num_layers=2, d_model=32, num_heads=4,
+                  d_ff=128, vocab_size=64)
+    cfg_t = TransformerConfig(**kw)
+    model = TransformerLM(cfg_t, machine, strategies)
+
+    def rebuild(ff_cfg, m):
+        return TransformerLM(cfg_t, m, ff_cfg.strategies)
+
+    return model, rebuild
+
+
+def _build_forward(name, machine, batch, dtype, strategies):
+    """A CNN/NMT model for the batched forward-only service, with the
+    strategy passed at CONSTRUCTION (placement decisions are taken while
+    the graph builds — setting config.strategies afterwards is too
+    late)."""
+    if name == "nmt":
+        from flexflow_tpu.nmt.rnn_model import RnnConfig, RnnModel
+
+        return RnnModel(RnnConfig(batch_size=batch, compute_dtype=dtype),
+                        machine, strategies)
+    from flexflow_tpu.apps.cnn import _builders
+    from flexflow_tpu.config import FFConfig
+
+    builders = _builders()
+    if name not in builders:
+        raise SystemExit(f"unknown model {name!r}")
+    size = 299 if name.startswith("inception") else 224
+    cfg = FFConfig(batch_size=batch, input_height=size, input_width=size,
+                   compute_dtype=dtype)
+    if strategies is not None:
+        cfg.strategies = strategies
+    return builders[name](cfg, machine)
+
+
+def _forward_payloads(model, requests, seed):
+    """Replace the loadgen token prompts with per-sample arrays matching
+    the model's first input spec (image tensors for CNNs, full token
+    rows for NMT) — the forward-only service pads these into the
+    compiled batch rectangle."""
+    import numpy as np
+
+    in0 = model._inputs[0]
+    shape = tuple(int(d) for d in in0.shape[1:])
+    rng = np.random.RandomState(seed)
+    for r in requests:
+        if np.issubdtype(np.dtype(in0.dtype), np.integer):
+            r.tokens = rng.randint(2, 64, size=shape).astype(in0.dtype)
+        else:
+            r.tokens = rng.uniform(-1.0, 1.0, size=shape).astype(in0.dtype)
+    return requests
+
+
+def _olog_metrics(opts, surface="serve"):
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs.metrics import MetricsExporter
+
+    meta = {"app": "serve", "model": opts["model"],
+            "requests": opts["requests"], "seed": opts["seed"]}
+    if opts["obs_dir"]:
+        run_id = opts["run_id"] or obs.new_run_id()
+        olog = obs.RunLog(
+            os.path.join(opts["obs_dir"], f"{run_id}.jsonl"),
+            run_id=run_id, surface=surface, meta=meta)
+    else:
+        olog = obs.NULL
+    metrics = MetricsExporter(opts["metrics_path"], meta=meta) \
+        if opts["metrics_path"] else None
+    return olog, metrics
+
+
+def _result_line(summary, olog) -> str:
+    """The one stdout JSON line: the smoke-asserted keys first, detail
+    after — one record, mirroring bench.py's contract."""
+    rec = {
+        "run_id": olog.run_id if olog.enabled else None,
+        "qps": summary["qps"],
+        "p50_s": summary["p50_s"],
+        "p99_s": summary["p99_s"],
+        "resizes": summary["resizes"],
+        "requests": summary["requests"],
+        "completed": summary["completed"],
+        "unserved": summary["unserved"],
+        "dropped": summary["dropped"],
+        "devices": summary["devices"],
+        "drained": summary["drained"],
+    }
+    return json.dumps(rec)
+
+
+def serve_run(opts, log=_err) -> dict:
+    """One serving run with the production wiring: plan-vetted strategy,
+    obs + metrics, drain handler installed, autoscale watermarks from
+    the flags.  Returns the engine summary (caller prints the line)."""
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.serve.engine import ServeEngine
+    from flexflow_tpu.serve.loadgen import synthetic_requests
+    from flexflow_tpu.strategy import Strategy
+    from flexflow_tpu.utils.elastic import install_drain_handler
+    from flexflow_tpu.verify.plan import check_plan
+
+    machine = MachineModel()
+    batch = opts["max_batch"] or opts["batch_size"]
+    strategies = None
+    if opts["strategy"]:
+        strategies = Strategy.load(opts["strategy"])
+
+    if opts["model"] in ("transformer", "gpt", "bert"):
+        model, rebuild = _build_lm(
+            machine, batch=batch, seed=opts["seed"],
+            dtype=opts["dtype"], strategies=strategies,
+            tiny=opts["tiny"])
+        decode = True
+    else:
+        model = _build_forward(opts["model"], machine, batch,
+                               opts["dtype"], strategies)
+        rebuild = None
+        decode = False
+    if strategies is not None:
+        # serving strategies are vetted forward-only with the KV cache
+        # charged (verify/plan.py detects the latency objective)
+        check_plan(model, strategies, machine,
+                   label=os.path.basename(opts["strategy"]))
+
+    olog, metrics = _olog_metrics(opts)
+    engine = ServeEngine(
+        model, rebuild, olog=olog, metrics=metrics, log=log,
+        step_time_s=opts["step_time_s"] or None,
+        queue_hi=opts["queue_hi"],
+        idle_boundaries=opts["idle_boundaries"],
+        shrink_to=opts["shrink_to"])
+    vocab = getattr(getattr(model, "t", None), "vocab_size", 64)
+    requests = synthetic_requests(
+        opts["requests"], seed=opts["seed"], rate_qps=opts["rate_qps"],
+        vocab_size=vocab, prompt_len=opts["prompt_len"],
+        max_new_tokens=opts["max_new_tokens"])
+    if not decode:
+        _forward_payloads(model, requests, opts["seed"])
+    drain = {}
+    restore = install_drain_handler(drain, log=log)
+    try:
+        summary = engine.run(requests, drain=drain) if decode \
+            else engine.run_forward(requests, drain=drain)
+    finally:
+        restore()
+    summary["_olog"] = olog
+    olog.close()
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# the deterministic --smoke scenario (make serve-smoke)
+
+
+def _smoke_equivalence(log) -> None:
+    """Batching on vs off must not change a single reply: the same five
+    requests served through a full 8-slot continuous batch and through a
+    1-slot engine on a 1-device mesh produce bit-identical token
+    sequences (row-independent decode + pad-inert rectangle)."""
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.serve.engine import ServeEngine
+    from flexflow_tpu.serve.loadgen import synthetic_requests
+
+    def replies(batch, machine):
+        model, _ = _build_lm(machine, batch=batch, seed=0, tiny=True)
+        eng = ServeEngine(model, None, log=lambda *a: None)
+        reqs = synthetic_requests(5, seed=0, rate_qps=1000.0,
+                                  vocab_size=64, prompt_len=4,
+                                  max_new_tokens=3)
+        eng.run(reqs)
+        return {r.rid: list(r.reply) for r in reqs}
+
+    m8 = MachineModel()
+    m1 = m8.shrink([0])
+    a = replies(8, m8)
+    b = replies(1, m1)
+    assert a == b, \
+        f"batched replies must be bit-identical to single-request " \
+        f"replies: {a} vs {b}"
+    log(f"serve-smoke equivalence ok: {len(a)} replies bit-identical "
+        f"with batching on (8 slots / 8 devices) vs off (1 slot / "
+        f"1 device)")
+
+
+def _smoke_lifecycle(opts, log) -> dict:
+    """Gap-then-burst load against the autoscaling engine: 6 early
+    requests, a 30-virtual-second idle gap (shrink 8 -> 6), then a
+    40-request burst (queue-depth grow 6 -> 8).  Asserts exactly one
+    resize per direction, zero unserved/dropped, finite latencies."""
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.obs.report import summarize
+    from flexflow_tpu.serve.engine import ServeEngine
+    from flexflow_tpu.serve.loadgen import synthetic_requests
+    from flexflow_tpu import obs
+
+    machine = MachineModel()
+    model, rebuild = _build_lm(machine, batch=24, seed=0,
+                               research_budget_s=2.0, tiny=True)
+    olog, metrics = _olog_metrics(opts)
+    engine = ServeEngine(model, rebuild, olog=olog, metrics=metrics,
+                         log=log, queue_hi=4, idle_boundaries=3,
+                         shrink_to=6)
+    early = synthetic_requests(6, seed=0, rate_qps=500.0, vocab_size=64,
+                               prompt_len=4, max_new_tokens=3)
+    burst = synthetic_requests(40, seed=1, rate_qps=2000.0,
+                               vocab_size=64, prompt_len=4,
+                               max_new_tokens=3,
+                               start_v=early[-1].arrival_v + 30.0)
+    for i, r in enumerate(burst):
+        r.rid = 100 + i
+    summary = engine.run(early + burst)
+
+    dirs = [(r["direction"], r["from_devices"], r["to_devices"])
+            for r in engine.resizes]
+    assert dirs == [("shrink", 8, 6), ("grow", 6, 8)], \
+        f"expected exactly one 8->6 shrink then one 6->8 grow, got {dirs}"
+    assert summary["completed"] == 46 and summary["unserved"] == 0 \
+        and summary["dropped"] == 0, summary
+    assert math.isfinite(summary["p50_s"]) \
+        and math.isfinite(summary["p99_s"]), summary
+    assert summary["devices"] == 8, \
+        f"run must END on the full mesh after the grow: {summary}"
+
+    if olog.enabled:
+        events = list(obs.read_run(olog.path))
+        srs = [e for e in events if e["kind"] == "serve_resize"]
+        assert [(r["direction"], r["from_devices"], r["to_devices"])
+                for r in srs] == dirs, srs
+        s = summarize(events)
+        assert s.get("serve", {}).get("summary", {}).get("dropped") == 0, \
+            s.get("serve")
+        # the smoke's obs dir must render through `report serve`
+        from flexflow_tpu.apps.report import serve_main
+
+        rendered = []
+        rc = serve_main([olog.path], log=lambda m: rendered.append(m))
+        assert rc == 0 and rendered \
+            and "latency histogram" in rendered[0], \
+            f"report serve must render the latency histogram: rc={rc}"
+        for line in rendered:
+            log(line)
+    log(f"serve-smoke lifecycle ok: {summary['completed']} served, "
+        f"resizes {dirs}, p50 {summary['p50_s'] * 1e3:.1f} ms, "
+        f"p99 {summary['p99_s'] * 1e3:.1f} ms")
+    summary["_olog"] = olog
+    olog.close()
+    return summary
+
+
+def smoke(opts, log=_err) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.device_count() != 8:
+        raise SystemExit(
+            f"serve --smoke needs the 8-device simulated mesh "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=8), "
+            f"got {jax.device_count()} devices")
+    _smoke_equivalence(log)
+    return _smoke_lifecycle(opts, log)
+
+
+def main(argv=None, log=_err) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    opts = parse_args(argv)
+    if opts["smoke"] and not opts["obs_dir"]:
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="ff-serve-smoke-") as td:
+            opts["obs_dir"] = os.path.join(td, "obs")
+            summary = smoke(opts, log)
+            print(_result_line(summary, summary.pop("_olog")))
+            return 0
+    summary = smoke(opts, log) if opts["smoke"] else serve_run(opts, log)
+    print(_result_line(summary, summary.pop("_olog")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

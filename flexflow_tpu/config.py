@@ -204,6 +204,15 @@ class FFConfig:
     # (ops/pallas/__init__.set_policy); "on" forces every supported
     # kernel; "off" keeps everything on the stock XLA path.
     pallas: str = "auto"
+    # serving runtime (serve/ package, apps/serve.py): --max-batch caps
+    # the continuous batcher's decode slots (0 = the model's batch_size);
+    # --serve-queue-hi is the queue-depth watermark that triggers a
+    # regrow of parked devices; --serve-idle-boundaries is how many
+    # consecutive idle decode boundaries trigger a shrink (0 disables
+    # autoscaling in that direction)
+    max_batch: int = 0
+    serve_queue_hi: int = 0
+    serve_idle_boundaries: int = 0
     # static plan analyzer (verify/plan.py, round 12): the drivers fail
     # fast on a strategy whose plan check reports errors; --allow-degraded
     # demotes the promoted degradation diagnostics (replicated/normalized
@@ -320,6 +329,12 @@ class FFConfig:
                 cfg.transient_reset_steps = int(val())
             elif a == "--ckpt-async":
                 cfg.ckpt_async = True
+            elif a == "--max-batch":
+                cfg.max_batch = int(val())
+            elif a == "--serve-queue-hi":
+                cfg.serve_queue_hi = int(val())
+            elif a == "--serve-idle-boundaries":
+                cfg.serve_idle_boundaries = int(val())
             elif a == "--allow-degraded":
                 cfg.allow_degraded = True
             elif a in ("-pallas", "--pallas"):

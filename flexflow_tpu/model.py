@@ -1572,6 +1572,52 @@ class FFModel:
 
         return jax.jit(eval_step)
 
+    def make_predict_step(self, output_tids=None):
+        """Jitted forward-only inference step — the serving path
+        (flexflow_tpu/serve/).  Differs from :meth:`make_eval_step`,
+        which exists for mid-training validation: no labels, no loss, no
+        accuracy — the step returns raw output tensors; no optimizer
+        state anywhere near the signature; and the BATCH arguments are
+        donated (a request's activations die with its reply) while
+        params/state are NOT (they persist across every request the
+        engine serves).  Dispatch is the exact training ``apply()``
+        path — strategies, placed/grouped execution, regrid — so a
+        searched serving strategy runs the same program the latency
+        objective priced.
+
+        ``output_tids``: tensor ids to return (in order); default is the
+        loss op's output (log-probs).  The serve engine passes the
+        softmax tid plus per-layer attention-input tids so the KV cache
+        can be filled from the same forward.  Positional ``batch`` args
+        align with ``self._inputs`` (the transformer's labels input is
+        fed zeros by the engine — the softmax op reads it but only
+        ``loss()`` consumes it, and serving never calls ``loss()``)."""
+        import jax
+        import jax.numpy as jnp
+
+        tids = tuple(output_tids) if output_tids is not None \
+            else (self._loss_op().output.tid,)
+        cdtype = self.config.compute_dtype
+
+        def predict_step(params, state, *batch):
+            if self._mixed_precision():
+                params = jax.tree.map(
+                    lambda v: v.astype(cdtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v,
+                    params)
+            inputs = {}
+            for t, b in zip(self._inputs, batch):
+                if jnp.issubdtype(b.dtype, jnp.floating):
+                    b = b.astype(cdtype)
+                inputs[t.tid] = b
+            values, _ = self.apply(params, state, inputs, train=False)
+            return tuple(values[tid] for tid in tids)
+
+        n_data = len(self._inputs)
+        return jax.jit(
+            predict_step,
+            donate_argnums=self._donate(tuple(range(2, 2 + n_data))))
+
     # ------------------------------------------------------------------
     # training loop (cnn.cc:110-128 parity: timed loop printing images/s)
 

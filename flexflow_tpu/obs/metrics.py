@@ -69,9 +69,17 @@ _HELP = {
                            "(0 or 1)",
     "drain_pending": "1 while a SIGTERM/SIGINT graceful drain is "
                      "committing its final checkpoint, else 0",
+    "qps": "serving throughput (completed requests per virtual second)",
+    "queue_depth": "serving requests arrived but not yet admitted "
+                   "to a decode slot",
+    "latency_p50_s": "serving request latency p50 (virtual seconds, "
+                     "arrival to completion)",
+    "latency_p99_s": "serving request latency p99 (virtual seconds)",
+    "requests_total": "serving requests completed this run",
 }
 _COUNTERS = {"steps_total", "rollbacks_total", "faults_total",
-             "prefetch_stall_seconds_total", "elastic_events"}
+             "prefetch_stall_seconds_total", "elastic_events",
+             "requests_total"}
 
 
 def _finite(v) -> Optional[float]:

@@ -404,6 +404,78 @@ def _search_section(events: List[Dict]) -> List[str]:
     return lines
 
 
+def _latency_histogram(lat: List[float], buckets: int = 10) -> List[str]:
+    """Fixed-width latency histogram lines: one row per bucket with its
+    bound, count, and a proportional bar — the ``report serve``
+    rendering of the smoke's obs stream."""
+    if not lat:
+        return []
+    lo, hi = min(lat), max(lat)
+    span = (hi - lo) or max(hi, 1e-9)
+    counts = [0] * buckets
+    for v in lat:
+        counts[min(int((v - lo) / span * buckets), buckets - 1)] += 1
+    peak = max(counts)
+    lines = []
+    for i, c in enumerate(counts):
+        hi_edge = lo + span * (i + 1) / buckets
+        bar = "█" * int(round(24 * c / peak)) if peak else ""
+        lines.append(f"    <= {_fmt_s(hi_edge):>10s}  {c:>5d}  {bar}")
+    return lines
+
+
+def _serve_section(events: List[Dict]) -> List[str]:
+    """The serving-runtime records: per-request latencies (histogram +
+    percentiles), batch occupancy, autoscale resizes, the run summary."""
+    reqs = [e for e in events if e.get("kind") == "serve_request"]
+    batches = [e for e in events if e.get("kind") == "serve_batch"]
+    resizes = [e for e in events if e.get("kind") == "serve_resize"]
+    summaries = [e for e in events if e.get("kind") == "serve_summary"]
+    if not (reqs or batches or resizes or summaries):
+        return []
+    lines = ["== serving =="]
+    lat = sorted(float(e["latency_s"]) for e in reqs
+                 if e.get("latency_s") is not None)
+    if lat:
+        def pct(q):
+            return lat[min(int(q / 100.0 * len(lat)), len(lat) - 1)]
+        lines.append(
+            f"  requests: {len(reqs)} completed, latency p50 "
+            f"{_fmt_s(pct(50))} / p90 {_fmt_s(pct(90))} / p99 "
+            f"{_fmt_s(pct(99))} (min {_fmt_s(lat[0])}, max "
+            f"{_fmt_s(lat[-1])})")
+        lines.append("  latency histogram (virtual seconds):")
+        lines.extend(_latency_histogram(lat))
+    if batches:
+        occ = [float(b.get("active", 0)) for b in batches]
+        admitted = sum(int(b.get("admitted", 0)) for b in batches)
+        lines.append(
+            f"  batches: {len(batches)} steps, {admitted} admissions, "
+            f"occupancy mean {sum(occ) / len(occ):.1f} / max "
+            f"{max(occ):.0f}   {_spark(occ)}")
+    for r in resizes:
+        research = r.get("research") or {}
+        lines.append(
+            f"  serve_resize[{r.get('direction', '?')}]: "
+            f"{r.get('from_devices', '?')} -> {r.get('to_devices', '?')} "
+            f"devices at step {r.get('step', '?')} (queue depth "
+            f"{r.get('queue_depth', '?')}, idle streak "
+            f"{r.get('idle_streak', '?')}, re-search "
+            f"{_fmt_s(r.get('research_s', 0.0))} "
+            f"[{research.get('mode', '?')}])")
+    for s in summaries:
+        lines.append(
+            f"  summary: {s.get('completed', 0)}/{s.get('requests', 0)} "
+            f"served ({s.get('unserved', 0)} unserved, "
+            f"{s.get('dropped', 0)} dropped), qps "
+            f"{s.get('qps', 0.0):.1f}, p50 {_fmt_s(s.get('p50_s', 0.0))},"
+            f" p99 {_fmt_s(s.get('p99_s', 0.0))}, "
+            f"{s.get('resizes', 0)} resize(s), "
+            f"{s.get('devices', '?')} devices"
+            + (", drained" if s.get("drained") else ""))
+    return lines
+
+
 def _audit_bench_section(events: List[Dict]) -> List[str]:
     audits = [e for e in events if e.get("kind") == "hlo_audit"]
     benches = [e for e in events if e.get("kind") == "bench"]
@@ -488,7 +560,9 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "device_loss", "device_probe", "elastic_resize",
              "elastic_fallback", "elastic_refused", "elastic_rejoin",
              "device_return", "step_hang", "preempt_drain",
-             "ckpt_async", "lint"}
+             "ckpt_async", "lint",
+             "serve_request", "serve_batch", "serve_resize",
+             "serve_summary"}
     lines = []
     for e in events:
         kind = e.get("kind")
@@ -515,7 +589,7 @@ def render(events: Iterable[Dict]) -> str:
         return "(empty run log)"
     sections = [_header(events), _fit_section(events),
                 _fault_section(events), _elastic_section(events),
-                _search_section(events),
+                _serve_section(events), _search_section(events),
                 _audit_bench_section(events), _lint_section(events),
                 _trace_section(events), _misc_section(events)]
     return "\n".join("\n".join(s) for s in sections if s)
@@ -745,6 +819,38 @@ def summarize(events: Iterable[Dict]) -> Dict:
                 "faults": max(int(a.get("faults", 0)) for a in asyncs),
             }
         out["elastic"] = el
+    serve_kinds = ("serve_request", "serve_batch", "serve_resize",
+                   "serve_summary")
+    if any(kinds.get(k) for k in serve_kinds):
+        sv: Dict = {"counts": {k: kinds[k] for k in serve_kinds
+                               if kinds.get(k)}}
+        lat = sorted(float(e["latency_s"]) for e in events
+                     if e.get("kind") == "serve_request"
+                     and e.get("latency_s") is not None)
+        if lat:
+            sv["latency_s"] = {
+                "p50": lat[min(len(lat) // 2, len(lat) - 1)],
+                "p99": lat[min(int(0.99 * len(lat)), len(lat) - 1)],
+                "min": lat[0], "max": lat[-1], "n": len(lat)}
+        srs = [e for e in events if e.get("kind") == "serve_resize"]
+        if srs:
+            sv["resizes"] = [
+                {"direction": r.get("direction"),
+                 "from_devices": r.get("from_devices"),
+                 "to_devices": r.get("to_devices"),
+                 "step": r.get("step"),
+                 "research_s": r.get("research_s"),
+                 "research_mode": (r.get("research") or {}).get("mode")}
+                for r in srs]
+        sums = [e for e in events if e.get("kind") == "serve_summary"]
+        if sums:
+            s = sums[-1]
+            sv["summary"] = {k: s.get(k) for k in
+                             ("requests", "completed", "unserved",
+                              "dropped", "qps", "p50_s", "p99_s", "steps",
+                              "resizes", "virtual_s", "drained",
+                              "devices")}
+        out["serve"] = sv
     fault_kinds = ("fault", "rollback", "recovery", "data_fault",
                    "ckpt_fallback", "thread_leak")
     if any(kinds.get(k) for k in fault_kinds):

@@ -1,0 +1,46 @@
+"""Continuous-batching inference runtime (the serving half of the system).
+
+Every driver before this package was a training loop; the ROADMAP's
+"heavy traffic from millions of users" needs a forward-only executor.
+The pieces, in dependency order:
+
+  * :mod:`flexflow_tpu.serve.loadgen` — seeded synthetic request source
+    (Poisson arrivals in VIRTUAL seconds, so admission order, latencies
+    and autoscale triggers are bit-deterministic under a fixed seed);
+  * :mod:`flexflow_tpu.serve.batcher` — the request queue and the
+    continuous batcher: join-on-arrival up to ``--max-batch`` decode
+    slots, slot reclaim on EOS, plus the padded batch assembly generator
+    the CNN/NMT forward-only service stages through
+    :class:`~flexflow_tpu.data.prefetch.DevicePrefetcher`;
+  * :mod:`flexflow_tpu.serve.kv_cache` — sharded KV-cache layout derived
+    from the attention op's strategy entry (('s','h','n') grid), ring-
+    buffer slot positions, byte accounting via
+    ``sim.cost_model.dtype_bytes`` (bf16-aware) that
+    ``verify/memory.py`` charges against per-device HBM;
+  * :mod:`flexflow_tpu.serve.engine` — the executor: forward-only
+    ``FFModel.make_predict_step`` dispatch (strategies, placed/grouped
+    execution and regrid all reused), transformer autoregressive decode,
+    queue-depth/idle watermark autoscaling through the elastic runtime's
+    shrink/grow primitives, SIGTERM graceful drain, and the
+    ``serve_request`` / ``serve_batch`` / ``serve_resize`` /
+    ``serve_summary`` obs records + Prometheus gauges.
+
+The strategy-search side lives where search already lives:
+``sim/search.py`` grows ``objective="latency"`` (price ONE forward step
+from the same native simulator tables) and ``apps/search.py --serve``
+emits a serving strategy artifact that ``verify/plan.py`` vets with
+forward-only memory accounting.  ``apps/serve.py`` is the driver;
+``make serve-smoke`` is the deterministic CPU gate.
+"""
+
+from flexflow_tpu.serve.batcher import (ContinuousBatcher, RequestQueue,
+                                        batch_requests)
+from flexflow_tpu.serve.engine import ServeEngine
+from flexflow_tpu.serve.kv_cache import KVCache, KVCacheLayout, kv_cache_bytes
+from flexflow_tpu.serve.loadgen import Request, synthetic_requests
+
+__all__ = [
+    "ContinuousBatcher", "KVCache", "KVCacheLayout", "Request",
+    "RequestQueue", "ServeEngine", "batch_requests", "kv_cache_bytes",
+    "synthetic_requests",
+]

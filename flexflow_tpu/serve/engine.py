@@ -1,0 +1,440 @@
+"""The serving executor: forward-only dispatch, decode, autoscale, drain.
+
+One :class:`ServeEngine` owns a live model and its placed (params,
+state) and runs two service shapes through the SAME compiled machinery
+training uses (``FFModel.apply`` under ``make_predict_step`` — per-op
+strategies, placed/grouped dispatch, the regrid planner, donation on the
+request activations):
+
+  * :meth:`run` — transformer autoregressive decode with continuous
+    batching: requests join the running ``(max_batch, seq)`` rectangle
+    the step a slot frees, greedy argmax on the causal log-probs at each
+    sequence's last position, EOS/token-budget slot reclaim, and a
+    sharded KV cache (serve/kv_cache.py) filled from the forward's own
+    per-layer attention inputs;
+  * :meth:`run_forward` — batched forward-only service for CNN/NMT:
+    padded fixed-shape batches staged through
+    :class:`~flexflow_tpu.data.prefetch.DevicePrefetcher` (host assembly
+    + H2D overlapped with device compute, the training staging pattern).
+
+Time is VIRTUAL (serve/loadgen.py): the clock advances by
+``step_time_s`` per decode step, so admission order, latencies,
+watermark triggers and the summary metrics are bit-deterministic under a
+seeded load.  Wall time is tracked separately and reported as
+information.
+
+**Autoscaling** reuses the elastic runtime's primitives directly
+(utils/elastic.py — the ROADMAP's "the elastic runtime is the autoscaler
+for free"): at decode-step boundaries, ``idle_boundaries`` consecutive
+empty boundaries shrink the mesh to ``shrink_to`` devices (gather state
+-> ``MachineModel.shrink`` -> budgeted re-search -> rebuild -> live
+regrid), and queue depth >= ``queue_hi`` with parked devices grows it
+back — each resize is one ``serve_resize`` obs record.  **Drain**: a
+SIGTERM flag (utils/elastic.install_drain_handler) stops admission, the
+in-flight slots finish and the engine returns cleanly — never-admitted
+requests are reported as ``unserved``, not dropped.
+
+Obs records: ``serve_request`` (one per completed request),
+``serve_batch`` (one per decode step / forward batch), ``serve_resize``
+(one per autoscale event), ``serve_summary`` (one per run).  Prometheus
+gauges: ``ff_qps``, ``ff_queue_depth``, ``ff_latency_p50_s``,
+``ff_latency_p99_s``, ``ff_requests_total``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flexflow_tpu.serve.batcher import (ContinuousBatcher, RequestQueue,
+                                        batch_requests)
+from flexflow_tpu.serve.kv_cache import KVCache, KVCacheLayout
+from flexflow_tpu.serve.loadgen import Request
+
+# default virtual service time per decode step / forward batch, used
+# when the strategy artifact carries no predicted forward time
+DEFAULT_STEP_TIME_S = 0.01
+
+
+def _percentile(values: Sequence[float], q: float) -> float:
+    if not values:
+        return float("nan")
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class ServeEngine:
+    """Continuous-batching inference over one live FFModel.
+
+    ``rebuild(ff_config, machine)`` is the same factory the elastic
+    training path takes — without it autoscaling is disabled (the engine
+    still serves, fixed-size).  ``queue_hi`` / ``idle_boundaries`` /
+    ``shrink_to`` are the watermarks; 0 disables the corresponding
+    trigger."""
+
+    def __init__(self, model, rebuild=None, *, olog=None, metrics=None,
+                 log=print, step_time_s: Optional[float] = None,
+                 queue_hi: int = 0, idle_boundaries: int = 0,
+                 shrink_to: int = 0, kv_window: Optional[int] = None,
+                 pad_id: int = 0):
+        from flexflow_tpu import obs
+
+        self.model = model
+        self.rebuild = rebuild
+        self.olog = olog if olog is not None else obs.NULL
+        self.metrics = metrics
+        self.log = log
+        self.queue_hi = int(queue_hi)
+        self.idle_boundaries = int(idle_boundaries)
+        self.shrink_to = int(shrink_to)
+        self.kv_window = kv_window
+        self.pad_id = int(pad_id)
+        self.max_batch = int(model.config.batch_size)
+        self.max_len = int(model._inputs[0].shape[1]) \
+            if model._inputs[0].ndim >= 2 else 1
+        self.step_time_s = float(step_time_s) if step_time_s else \
+            self._predicted_step_time()
+        self.resizes: List[Dict] = []
+        self._parked: List = []       # device OBJECTS out of service
+        self.params = None
+        self.state = None
+        self.kv_cache: Optional[KVCache] = None
+        self._compile()
+
+    # ------------------------------------------------------------------
+    # compilation / state
+
+    def _predicted_step_time(self) -> float:
+        pred = getattr(getattr(self.model.config, "strategies", None),
+                       "predicted", None) or {}
+        serve = pred.get("serve") or {}
+        t = serve.get("forward_step_s")
+        return float(t) if t else DEFAULT_STEP_TIME_S
+
+    def _attention_ops(self) -> List:
+        from flexflow_tpu.ops.attention import MultiHeadAttention
+
+        return [op for op in self.model.layers
+                if isinstance(op, MultiHeadAttention)]
+
+    def _compile(self, carry: Optional[Dict] = None) -> None:
+        """(Re)build the predict step, the KV layout and the host K/V
+        projection weights for the CURRENT model — called at init and
+        after every resize."""
+        model = self.model
+        if carry is not None:
+            self.params, self.state = carry["params"], carry["state"]
+        elif self.params is None:
+            self.params, self.state = model.init(model.config.seed)
+        self._attn_ops = self._attention_ops()
+        loss_tid = model._loss_op().output.tid
+        tids = (loss_tid,) + tuple(op.inputs[0].tid
+                                   for op in self._attn_ops)
+        self._predict = model.make_predict_step(output_tids=tids)
+        # host mirrors of each layer's K/V projections, used to fill the
+        # cache from the forward's attention inputs (exact by
+        # construction: the same einsum ops/attention.py projects with)
+        self._kv_w = []
+        for op in self._attn_ops:
+            p = model._member_params(self.params, op)
+            self._kv_w.append((np.asarray(p["wk"]).astype(np.float32),
+                               np.asarray(p["wv"]).astype(np.float32)))
+        layout = KVCacheLayout.from_model(
+            model, self.max_batch, self.kv_window,
+            strategy=getattr(model.config, "strategies", None))
+        self.kv_layout = layout
+        self.kv_cache = KVCache(layout) if layout is not None else None
+        self._kv_filled = [0] * self.max_batch
+
+    def _zero_extra_inputs(self) -> List[np.ndarray]:
+        """Zero arrays for every model input past the first (the
+        transformer's ``labels`` feed — read by the softmax op's graph
+        but consumed only by ``loss()``, which serving never calls)."""
+        out = []
+        for t in self.model._inputs[1:]:
+            out.append(np.zeros(t.shape, t.dtype))
+        return out
+
+    # ------------------------------------------------------------------
+    # decode service
+
+    def run(self, requests: Sequence[Request],
+            drain: Optional[Dict] = None) -> Dict:
+        """Serve ``requests`` to completion (or drain) and return the
+        summary dict (also emitted as the ``serve_summary`` record)."""
+        t_wall0 = time.perf_counter()
+        queue = RequestQueue(requests)
+        batcher = ContinuousBatcher(self.max_batch, self.max_len)
+        vnow = 0.0
+        steps = 0
+        idle_streak = 0
+        draining = False
+        completed: List[Request] = []
+        unserved: List[Request] = []
+        extra = self._zero_extra_inputs()
+
+        while queue.pending() or batcher.num_active():
+            if drain is not None and drain.get("requested") \
+                    and not draining:
+                draining = True
+                unserved = queue.drain()
+                self.log(f"serve: drain requested — finishing "
+                         f"{batcher.num_active()} in-flight request(s), "
+                         f"{len(unserved)} queued request(s) unserved")
+            admitted = [] if draining else batcher.admit(queue, vnow)
+            depth = queue.depth(vnow)
+            if (self.queue_hi > 0 and depth >= self.queue_hi
+                    and self._parked and not draining):
+                self._resize("grow", steps, vnow, depth, idle_streak)
+                # the regrown mesh serves the backlog from the next step
+                admitted += batcher.admit(queue, vnow)
+                depth = queue.depth(vnow)
+            if batcher.num_active() == 0:
+                nxt = queue.next_arrival()
+                if nxt is None:
+                    break  # drained queue, no in-flight work
+                # idle boundary: no work until the next arrival
+                idle_streak += 1
+                if (self.idle_boundaries > 0
+                        and idle_streak >= self.idle_boundaries
+                        and not self._parked and not draining):
+                    self._resize("shrink", steps, vnow, depth,
+                                 idle_streak)
+                if (self.idle_boundaries <= 0
+                        or idle_streak > self.idle_boundaries):
+                    vnow = max(vnow, nxt)  # nothing left to trigger
+                else:
+                    vnow = min(vnow + self.step_time_s, nxt)
+                continue
+            idle_streak = 0
+
+            # one decode step over the full rectangle
+            active = batcher.active()
+            pre_lengths = {i: s.length for i, s in active}
+            tokens = batcher.token_matrix(self.pad_id)
+            t0 = time.perf_counter()
+            outs = self._predict(self.params, self.state, tokens, *extra)
+            logprobs = np.asarray(outs[0])
+            step_wall = time.perf_counter() - t0
+            self._fill_kv(outs[1:], active, pre_lengths)
+            for slot_idx, slot in active:
+                nxt_tok = int(np.argmax(logprobs[slot_idx,
+                                                 slot.length - 1]))
+                slot.req.wall_s += step_wall
+                batcher.record_token(slot_idx, nxt_tok)
+            vnow += self.step_time_s
+            steps += 1
+            for slot_idx, req in batcher.reclaim(vnow):
+                if self.kv_cache is not None:
+                    self.kv_cache.reclaim(slot_idx)
+                self._kv_filled[slot_idx] = 0
+                completed.append(req)
+                self.olog.event(
+                    "serve_request", rid=req.rid, arrival_v=req.arrival_v,
+                    admit_v=req.admit_v, done_v=req.done_v,
+                    latency_s=req.latency_s, prompt_len=len(req.tokens),
+                    new_tokens=len(req.reply or ()), wall_s=req.wall_s)
+            self.olog.event("serve_batch", step=steps, vnow=vnow,
+                            active=len(active), admitted=len(admitted),
+                            queue_depth=depth,
+                            devices=self.model.machine.num_devices)
+            self._update_gauges(completed, depth, vnow)
+
+        return self._summarize(completed, unserved, vnow, steps,
+                               time.perf_counter() - t_wall0,
+                               drained=draining)
+
+    def _fill_kv(self, attn_ins, active, pre_lengths) -> None:
+        """Project this step's NEW positions into the KV cache from the
+        captured per-layer attention inputs."""
+        if self.kv_cache is None:
+            return
+        xs = [np.asarray(x).astype(np.float32) for x in attn_ins]
+        h, hd = self.kv_layout.num_heads, self.kv_layout.head_dim
+        for li, (wk, wv) in enumerate(self._kv_w):
+            x = xs[li]
+            for slot_idx, slot in active:
+                lo = self._kv_filled[slot_idx]
+                hi_ = pre_lengths[slot_idx]
+                if hi_ <= lo:
+                    continue
+                span = x[slot_idx, lo:hi_, :]          # (n, d)
+                k = (span @ wk).reshape(-1, h, hd)
+                v = (span @ wv).reshape(-1, h, hd)
+                self.kv_cache.write_span(li, slot_idx, lo, k, v)
+        for slot_idx, _ in active:
+            self._kv_filled[slot_idx] = pre_lengths[slot_idx]
+
+    # ------------------------------------------------------------------
+    # forward-only service (CNN / NMT)
+
+    def run_forward(self, requests: Sequence[Request],
+                    drain: Optional[Dict] = None) -> Dict:
+        """Batched forward-only service: padded fixed-shape batches
+        staged through DevicePrefetcher; replies are the loss op's
+        output rows.  Request meta rides host-side in FIFO order (the
+        prefetcher's determinism contract), never through device
+        placement."""
+        from collections import deque
+
+        from flexflow_tpu.data.prefetch import DevicePrefetcher
+
+        t_wall0 = time.perf_counter()
+        model = self.model
+        in0 = model._inputs[0]
+        sample_shape = tuple(in0.shape[1:])
+        ordered = sorted(requests, key=lambda r: (r.arrival_v, r.rid))
+        unserved: List[Request] = []
+        if drain is not None and drain.get("requested"):
+            ordered, unserved = [], list(ordered)
+        meta: deque = deque()
+
+        def arrays():
+            for batch, members in batch_requests(
+                    iter(ordered), self.max_batch,
+                    pad_shape=sample_shape, dtype=in0.dtype):
+                meta.append(members)
+                yield batch
+
+        predict = model.make_predict_step()
+        extra = self._zero_extra_inputs()
+        completed: List[Request] = []
+        vnow = 0.0
+        batches = 0
+        with DevicePrefetcher(arrays(), machine=model.machine,
+                              olog=self.olog) as pf:
+            for batch in pf:
+                members = meta.popleft()
+                vstart = max(vnow,
+                             max(r.arrival_v for r in members))
+                t0 = time.perf_counter()
+                out = np.asarray(predict(self.params, self.state,
+                                         batch, *extra)[0])
+                wall = time.perf_counter() - t0
+                vnow = vstart + self.step_time_s
+                batches += 1
+                for i, req in enumerate(members):
+                    req.admit_v = vstart
+                    req.done_v = vnow
+                    req.wall_s = wall
+                    req.reply = out[i]
+                    completed.append(req)
+                    self.olog.event(
+                        "serve_request", rid=req.rid,
+                        arrival_v=req.arrival_v, admit_v=req.admit_v,
+                        done_v=req.done_v, latency_s=req.latency_s,
+                        prompt_len=int(np.asarray(req.tokens).shape[0])
+                        if np.asarray(req.tokens).ndim else 0,
+                        new_tokens=0, wall_s=wall)
+                self.olog.event("serve_batch", step=batches, vnow=vnow,
+                                active=len(members), admitted=len(members),
+                                queue_depth=0,
+                                devices=model.machine.num_devices)
+        return self._summarize(completed, unserved, vnow, batches,
+                               time.perf_counter() - t_wall0,
+                               drained=bool(unserved))
+
+    # ------------------------------------------------------------------
+    # autoscaling
+
+    def _resize(self, direction: str, step: int, vnow: float,
+                depth: int, idle_streak: int) -> None:
+        """One autoscale event through the elastic primitives: gather the
+        live (params, state), resize the machine, re-search under the
+        serving objective, rebuild, regrid — then recompile the predict
+        step and reset the KV cache to the new layout."""
+        import copy
+
+        from flexflow_tpu.utils.elastic import (gather_state,
+                                                research_strategy)
+
+        if self.rebuild is None:
+            return
+        t0 = time.perf_counter()
+        model = self.model
+        machine = model.machine
+        n_old = machine.num_devices
+        cfg = model.config
+        if direction == "shrink":
+            target = self.shrink_to
+            min_devices = max(int(getattr(cfg, "min_devices", 1) or 1), 1)
+            if not (min_devices <= target < n_old):
+                return
+            if self.max_batch % target:
+                return  # the batch rectangle must divide the new mesh
+            live = list(range(target))
+            parked = [machine.devices[i] for i in range(target, n_old)]
+            new_machine = machine.shrink(live)
+        else:
+            if not self._parked:
+                return
+            new_machine = machine.grow(self._parked)
+            parked = []
+        full_p, full_s, _ = gather_state(model, self.params, self.state,
+                                         None)
+        t_search = time.perf_counter()
+        strategy, research = research_strategy(
+            cfg, self.rebuild, new_machine,
+            getattr(cfg, "strategies", None), olog=self.olog,
+            log=self.log, objective="latency")
+        research_s = time.perf_counter() - t_search
+        final_cfg = copy.copy(cfg)
+        final_cfg.strategies = strategy
+        new_model = self.rebuild(final_cfg, new_machine)
+        params, state, _ = new_model.place_state(full_p, full_s, {})
+        self.model = new_model
+        self.params, self.state = params, state
+        self._parked = parked
+        self._compile(carry={"params": params, "state": state})
+        n_new = new_machine.num_devices
+        rec = {
+            "direction": direction, "from_devices": n_old,
+            "to_devices": n_new, "step": step, "vnow": vnow,
+            "queue_depth": depth, "idle_streak": idle_streak,
+            "research_s": research_s, "research": research,
+            "total_s": time.perf_counter() - t0,
+        }
+        self.resizes.append(rec)
+        self.olog.event("serve_resize", **rec)
+        self.log(f"serve: {direction} {n_old} -> {n_new} devices at step "
+                 f"{step} (queue depth {depth}, idle streak "
+                 f"{idle_streak}, re-search {research_s:.2f}s "
+                 f"[{research['mode']}])")
+
+    # ------------------------------------------------------------------
+    # reporting
+
+    def _update_gauges(self, completed, depth, vnow) -> None:
+        if self.metrics is None:
+            return
+        lat = [r.latency_s for r in completed if r.latency_s is not None]
+        self.metrics.update(
+            qps=(len(completed) / vnow) if vnow > 0 else 0.0,
+            queue_depth=depth,
+            latency_p50_s=_percentile(lat, 50) if lat else None,
+            latency_p99_s=_percentile(lat, 99) if lat else None,
+            requests_total=len(completed))
+        self.metrics.write()
+
+    def _summarize(self, completed, unserved, vnow, steps, wall_s,
+                   drained=False) -> Dict:
+        lat = [r.latency_s for r in completed if r.latency_s is not None]
+        summary = {
+            "requests": len(completed) + len(unserved),
+            "completed": len(completed),
+            "unserved": len(unserved),
+            "dropped": 0,
+            "qps": (len(completed) / vnow) if vnow > 0 else 0.0,
+            "p50_s": _percentile(lat, 50),
+            "p99_s": _percentile(lat, 99),
+            "steps": steps,
+            "resizes": len(self.resizes),
+            "virtual_s": vnow,
+            "wall_s": wall_s,
+            "drained": bool(drained),
+            "devices": self.model.machine.num_devices,
+        }
+        self.olog.event("serve_summary", **summary)
+        self._update_gauges(completed, 0, vnow)
+        return summary

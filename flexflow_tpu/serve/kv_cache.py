@@ -1,0 +1,201 @@
+"""Sharded KV cache for autoregressive decode: layout, slots, bytes.
+
+The cache's SHAPE is not a free choice here — it is derived from each
+attention op's strategy entry, the same ('s', 'h', 'n') grid the search
+assigned (ops/attention.py AXIS_NAMES): heads shard over the 'h' parts,
+batch slots over the 'n' parts, and the sequence extent over the 's'
+parts (ring context parallelism keeps O(S/p_s) cache per chip exactly as
+it keeps O(S/p_s) activations).  Byte accounting goes through
+``sim.cost_model.dtype_bytes`` so a bf16 serving config (``--dtype
+bfloat16``) halves the cache footprint the same way it halves activation
+bytes everywhere else; ``verify/memory.py`` charges
+:func:`kv_cache_bytes` against the per-device HBM peak when vetting a
+serving strategy.
+
+Slots are RING buffers: position ``p`` of slot ``b`` lives at row
+``p % max_seq``, so a sequence longer than the window overwrites its
+oldest entries (sliding-window attention's storage contract) instead of
+growing.
+
+Honesty note on the execution path: the CPU reference decode
+(serve/engine.py) runs the full windowed forward through
+``FFModel.apply`` — the placed/grouped dispatch being reused is the
+point — and recomputes attention from the in-window tokens; this cache
+is FILLED from that same forward (K/V projected with the op's own
+weights, exact by construction, pinned by tests) and carries the layout
++ byte accounting the incremental TPU decode kernel targets.  What would
+change on TPU is the consumer, not this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flexflow_tpu.sim.cost_model import dtype_bytes
+
+
+def _attention_ops(model) -> List:
+    from flexflow_tpu.ops.attention import MultiHeadAttention
+
+    return [op for op in model.layers
+            if isinstance(op, MultiHeadAttention)]
+
+
+def _grid_for(op, strategy, machine) -> Tuple[int, int, int]:
+    """(s_parts, h_parts, n_parts) for one attention op: its strategy
+    entry when present, else the machine's pure-DP default (all parts on
+    'n'), else serial."""
+    pc = None
+    if strategy is not None:
+        pc = strategy.get(op.name)
+    if pc is None and machine is not None:
+        pc = machine.default_pc(3)
+    if pc is None:
+        return (1, 1, 1)
+    dims = tuple(pc.dims) + (1,) * (3 - len(pc.dims))
+    return (int(dims[0]), int(dims[1]), int(dims[2]))
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheLayout:
+    """Per-layer cache geometry + the sharding the strategy assigned."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    max_batch: int
+    max_seq: int
+    dtype: str = "float32"
+    # the widest grid across the model's attention entries (a cache
+    # sized for the most-sharded layer fits every layer)
+    s_parts: int = 1
+    h_parts: int = 1
+    n_parts: int = 1
+
+    @classmethod
+    def from_model(cls, model, max_batch: int,
+                   max_seq: Optional[int] = None,
+                   strategy=None) -> Optional["KVCacheLayout"]:
+        """Layout derived from ``model``'s attention ops and their
+        strategy entries; None for models with no attention (CNN/NMT
+        forward-only service carries no cache)."""
+        ops = _attention_ops(model)
+        if not ops:
+            return None
+        strategy = strategy if strategy is not None \
+            else getattr(model.config, "strategies", None)
+        machine = getattr(model, "machine", None)
+        s_p = h_p = n_p = 1
+        for op in ops:
+            s, h, n = _grid_for(op, strategy, machine)
+            s_p, h_p, n_p = max(s_p, s), max(h_p, h), max(n_p, n)
+        seq = int(max_seq) if max_seq is not None \
+            else int(ops[0].inputs[0].shape[1])
+        return cls(num_layers=len(ops), num_heads=ops[0].num_heads,
+                   head_dim=ops[0].head_dim, max_batch=int(max_batch),
+                   max_seq=seq, dtype=str(model.config.compute_dtype),
+                   s_parts=s_p, h_parts=h_p, n_parts=n_p)
+
+    # -- byte accounting -------------------------------------------------
+
+    def total_bytes(self) -> int:
+        """K + V across all layers, unsharded."""
+        return (2 * self.num_layers * self.max_batch * self.num_heads
+                * self.max_seq * self.head_dim * dtype_bytes(self.dtype))
+
+    def bytes_per_device(self) -> int:
+        """The HBM charge one device carries: heads split over 'h',
+        slots over 'n', the sequence window over 's' (ceil-sized shards,
+        matching the activation accounting in verify/memory.py)."""
+        heads = -(-self.num_heads // max(self.h_parts, 1))
+        batch = -(-self.max_batch // max(self.n_parts, 1))
+        seq = -(-self.max_seq // max(self.s_parts, 1))
+        return (2 * self.num_layers * batch * heads * seq * self.head_dim
+                * dtype_bytes(self.dtype))
+
+    def describe(self) -> Dict:
+        return {
+            "num_layers": self.num_layers, "num_heads": self.num_heads,
+            "head_dim": self.head_dim, "max_batch": self.max_batch,
+            "max_seq": self.max_seq, "dtype": self.dtype,
+            "grid": [self.s_parts, self.h_parts, self.n_parts],
+            "total_bytes": self.total_bytes(),
+            "bytes_per_device": self.bytes_per_device(),
+        }
+
+
+def kv_cache_bytes(model, max_batch: int, max_seq: Optional[int] = None,
+                   strategy=None) -> int:
+    """Per-device KV-cache bytes a serving deployment of ``model`` needs
+    (0 for attention-free models) — the term verify/memory.py adds to
+    the forward-only HBM peak."""
+    layout = KVCacheLayout.from_model(model, max_batch, max_seq,
+                                      strategy=strategy)
+    return 0 if layout is None else layout.bytes_per_device()
+
+
+class KVCache:
+    """Host-resident reference cache over :class:`KVCacheLayout`.
+
+    Arrays are the UNSHARDED logical view, shaped
+    ``(num_layers, max_batch, num_heads, max_seq, head_dim)`` in the
+    layout's compute dtype; the layout records how the strategy splits
+    them per device.  ``lengths[b]`` counts positions written to slot
+    ``b`` (monotonic across a sequence; row index wraps mod
+    ``max_seq``)."""
+
+    def __init__(self, layout: KVCacheLayout):
+        self.layout = layout
+        shape = (layout.num_layers, layout.max_batch, layout.num_heads,
+                 layout.max_seq, layout.head_dim)
+        # numpy has no native bfloat16: the HOST mirror stores bf16
+        # caches as f32 values (accounting still prices bf16 via the
+        # layout; the device cache would be bf16-typed)
+        dt = np.dtype("float32") if layout.dtype == "bfloat16" \
+            else np.dtype(layout.dtype)
+        self.k = np.zeros(shape, dt)
+        self.v = np.zeros(shape, dt)
+        self.lengths = np.zeros((layout.max_batch,), np.int64)
+
+    def write(self, layer: int, slot: int, pos: int,
+              k: np.ndarray, v: np.ndarray) -> None:
+        """Store one position's (num_heads, head_dim) K/V for one slot.
+        ``pos`` is the LOGICAL sequence position; the ring row is
+        ``pos % max_seq``."""
+        row = int(pos) % self.layout.max_seq
+        self.k[layer, slot, :, row, :] = k
+        self.v[layer, slot, :, row, :] = v
+        if layer == 0:
+            self.lengths[slot] = max(int(self.lengths[slot]), int(pos) + 1)
+
+    def write_span(self, layer: int, slot: int, start: int,
+                   k: np.ndarray, v: np.ndarray) -> None:
+        """Store ``k``/``v`` of shape (span, num_heads, head_dim) at
+        logical positions ``start..start+span`` (prompt prefill)."""
+        for i in range(k.shape[0]):
+            self.write(layer, slot, start + i,
+                       k[i], v[i])
+
+    def read(self, layer: int, slot: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(K, V) for one slot in LOGICAL position order, shape
+        ``(n, num_heads, head_dim)`` with ``n = min(length, max_seq)`` —
+        a wrapped ring is returned oldest-surviving-entry first."""
+        n = int(self.lengths[slot])
+        ms = self.layout.max_seq
+        if n <= ms:
+            rows = np.arange(n)
+        else:
+            rows = np.arange(n - ms, n) % ms
+        k = self.k[layer, slot, :, rows, :]
+        v = self.v[layer, slot, :, rows, :]
+        return k, v
+
+    def reclaim(self, slot: int) -> None:
+        """Free a finished sequence's slot (zeroed so a stale read is
+        visibly empty rather than silently another request's cache)."""
+        self.k[:, slot] = 0
+        self.v[:, slot] = 0
+        self.lengths[slot] = 0

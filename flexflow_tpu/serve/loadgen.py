@@ -1,0 +1,88 @@
+"""Seeded synthetic load generator: Poisson arrivals in VIRTUAL time.
+
+The serving smoke must be deterministic on CPU the way every other gate
+in this repo is (elastic-smoke, fault-smoke): same seed -> same
+admission order, same latencies, same autoscale triggers, bit-identical
+replies.  Real wall clocks cannot deliver that, so requests carry a
+VIRTUAL arrival time in seconds: inter-arrival gaps are drawn from a
+seeded exponential distribution (a Poisson process at ``rate_qps``) and
+the engine advances its own virtual clock by the per-step service time
+(:attr:`ServeEngine.step_time_s`).  Latency = virtual completion -
+virtual arrival; wall time is recorded separately, for information only.
+
+``gap_after``/``gap_s`` inject one idle window into the arrival stream —
+the smoke's lever for driving the idle-shrink watermark (traffic dies
+down, the mesh shrinks, the following burst grows it back).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request and its lifecycle stamps.
+
+    ``tokens`` is the prompt (int32 ids) for the LM decode path, or an
+    arbitrary per-sample input array for the CNN/NMT forward-only
+    service.  The ``*_v`` stamps are VIRTUAL seconds (the deterministic
+    clock); ``wall_s`` is the real service wall time, informational."""
+
+    rid: int
+    arrival_v: float
+    tokens: np.ndarray
+    max_new_tokens: int = 0
+    eos_id: int = -1
+    # filled by the engine:
+    admit_v: Optional[float] = None
+    done_v: Optional[float] = None
+    wall_s: float = 0.0
+    reply: Optional[List[int]] = None
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.done_v is None:
+            return None
+        return self.done_v - self.arrival_v
+
+
+def synthetic_requests(n: int, *, seed: int = 0, rate_qps: float = 100.0,
+                       vocab_size: int = 64, prompt_len: int = 4,
+                       max_new_tokens: int = 4, eos_id: int = -1,
+                       gap_after: Optional[int] = None,
+                       gap_s: float = 0.0,
+                       start_v: float = 0.0) -> List[Request]:
+    """``n`` deterministic requests with Poisson arrivals.
+
+    Prompts are uniform random ids in ``[2, vocab_size)`` — 0 is the pad
+    id the engine uses for empty positions and 1 the conventional EOS,
+    so prompts never collide with either.  ``gap_after`` > 0 inserts
+    ``gap_s`` of extra virtual idle time before request ``gap_after``
+    (0-indexed), carving the arrival stream into a front phase, an idle
+    window, and a burst."""
+    if n < 0:
+        raise ValueError(f"request count must be >= 0, got {n}")
+    if rate_qps <= 0:
+        raise ValueError(f"rate_qps must be > 0, got {rate_qps}")
+    lo = min(2, max(vocab_size - 1, 0))
+    rng = np.random.RandomState(seed)
+    out: List[Request] = []
+    t = float(start_v)
+    for i in range(n):
+        t += float(rng.exponential(1.0 / rate_qps))
+        if gap_after is not None and i == gap_after:
+            t += float(gap_s)
+        tokens = rng.randint(lo, max(vocab_size, lo + 1),
+                             size=(prompt_len,)).astype(np.int32)
+        out.append(Request(rid=i, arrival_v=t, tokens=tokens,
+                           max_new_tokens=max_new_tokens, eos_id=eos_id))
+    return out
+
+
+def as_iterator(requests: List[Request]) -> Iterator[Request]:
+    """Requests in arrival order (the queue's expected feed order)."""
+    return iter(sorted(requests, key=lambda r: (r.arrival_v, r.rid)))

@@ -424,14 +424,33 @@ class StrategySearch:
     def __init__(self, model: FFModel, machine: Optional[MachineModel] = None,
                  cost_model=None,
                  max_per_axis: Optional[Dict[str, int]] = None,
-                 placement: bool = True, obs=None):
+                 placement: bool = True, obs=None,
+                 objective: str = "makespan"):
         """``placement=False`` restricts candidates to canonical device
         lists (dims-only search, the round-1 behavior) — kept for A/B
         comparison of the placement dimension's value.  ``obs`` is an
         optional :class:`flexflow_tpu.obs.RunLog`; the build, search and
         pipeline proposal emit structured records into it (search_space /
         search_chunk / search_result / search_breakdown /
-        pipeline_candidate / pipeline_decision)."""
+        pipeline_candidate / pipeline_decision).
+
+        ``objective`` picks what one simulated step IS (the serving
+        round):
+
+          * ``"makespan"`` — a TRAINING step: forward + backward + the
+            gradient param sync + the optimizer's HBM stream (the
+            default, unchanged);
+          * ``"latency"`` — one forward/decode step of a SERVING
+            deployment: candidate compute and collective costs drop to
+            the forward third (the cost model prices fwd+bwd+wgrad as
+            exactly 3.0x forward in both the analytic bytes/flops terms
+            and the measured path's whole-step anchors), the per-param
+            sync bytes are zeroed (no gradients to all-reduce) and the
+            optimizer stream term vanishes (no optimizer).  Input-cast
+            rows keep their cost — the cast happens once per step in
+            both regimes.  Everything downstream (delta re-sim, chunked
+            MCMC, ``simulate_trace``, the breakdown) prices the serving
+            step with no further changes."""
         from flexflow_tpu import obs as _obs
 
         from flexflow_tpu.sim.cost_model import param_byte_scale
@@ -448,6 +467,11 @@ class StrategySearch:
             param_scale=self._param_scale)
         self.max_per_axis = max_per_axis
         self.placement = placement
+        if objective not in ("makespan", "latency"):
+            raise ValueError(
+                f"objective must be 'makespan' or 'latency', "
+                f"got {objective!r}")
+        self.objective = objective
         self.obs = obs or _obs.NULL
         n_dev = self.machine.num_devices
         self.inputs = [_InputSource(t, n_dev)
@@ -637,6 +661,18 @@ class StrategySearch:
             costs[i] = self.cost_model.op_cost(op, pc)
         if hasattr(self.cost_model, "flush"):
             self.cost_model.flush()
+        if self.objective == "latency":
+            # forward-only pricing (constructor docstring): the cost
+            # model's 3.0x fwd+bwd+wgrad convention makes the forward
+            # step exactly a third of every candidate's compute and
+            # collective cost; the gradient sync volume is zero.  The
+            # same table rows then serve the delta re-sim, the MCMC and
+            # the trace unchanged.  Input-source rows (the cast) are NOT
+            # in cost_pairs and keep their once-per-step cost.
+            for i, _, _ in cost_pairs:
+                costs[i] /= 3.0
+                colls[i] /= 3.0
+            pbytes = [0.0] * len(pbytes)
         # un-silence the pruning (VERDICT weak #5): what the search space
         # actually is, and what divisibility/memory removed from it
         logger.info(
@@ -653,6 +689,7 @@ class StrategySearch:
             devices=n_dev,
             ici_group=topo.devices_per_ici_group,
             placement=self.placement,
+            objective=self.objective,
             cost_model=type(self.cost_model).__name__)
         # the feasibility pre-gate's tally (round 12): proposals can only
         # draw from the per-op candidate lists, so every candidate the
@@ -684,10 +721,17 @@ class StrategySearch:
         # momentum rate).  Sharded params stream only their shard, but
         # DP — where this matters — replicates everything; charge the
         # whole footprint (upper bound for TP shards).
-        total_param_bytes = sum(pbytes)  # pbytes is already once-per-key
-        opt_bytes = self._opt_state_bytes(total_param_bytes)
-        self._opt_stream_s = (3.0 * total_param_bytes + 2.0 * opt_bytes) \
-            / (perf.hbm_bandwidth * perf.vector_efficiency)
+        if self.objective == "latency":
+            # serving runs no optimizer pass; the zero also keeps the
+            # "_opt_stream" sync event out of simulate_trace (emitted
+            # only when > 0)
+            self._opt_stream_s = 0.0
+        else:
+            total_param_bytes = sum(pbytes)  # already once-per-key
+            opt_bytes = self._opt_state_bytes(total_param_bytes)
+            self._opt_stream_s = \
+                (3.0 * total_param_bytes + 2.0 * opt_bytes) \
+                / (perf.hbm_bandwidth * perf.vector_efficiency)
 
     def _opt_state_bytes(self, total_param_bytes: float) -> float:
         """Bytes of the model's optimizer state, from jax.eval_shape over

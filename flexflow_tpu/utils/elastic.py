@@ -314,7 +314,8 @@ def warm_assignment(search, strategy, fallback=None) -> List[int]:
 
 
 def research_strategy(config, rebuild, new_machine, old_strategy,
-                      olog=None, log=print, fallback_strategy=None):
+                      olog=None, log=print, fallback_strategy=None,
+                      objective: str = "makespan"):
     """Re-run the native MCMC search for the resized mesh under the
     ``--research-budget-s`` wall clock, warm-started from
     ``old_strategy`` (entries missing there fall back to
@@ -323,7 +324,12 @@ def research_strategy(config, rebuild, new_machine, old_strategy,
     Degrades gracefully: when the native simulator (or the search
     itself) is unavailable, the mesh trains pure-DP — a correct plan,
     just not a searched one.  Returns ``(Strategy, info dict)``;
-    ``info["mode"]`` is ``"mcmc"`` or ``"dp_fallback"``."""
+    ``info["mode"]`` is ``"mcmc"`` or ``"dp_fallback"``.
+
+    ``objective`` is forwarded to :class:`StrategySearch` — the serving
+    autoscaler (serve/engine.py) re-searches its resized mesh under
+    ``"latency"`` (forward-step pricing) while training recovery keeps
+    the ``"makespan"`` default."""
     import copy
 
     from flexflow_tpu.strategy import Strategy
@@ -336,7 +342,8 @@ def research_strategy(config, rebuild, new_machine, old_strategy,
         shell_cfg = copy.copy(config)
         shell_cfg.strategies = Strategy()
         shell = rebuild(shell_cfg, new_machine)
-        ss = StrategySearch(shell, machine=new_machine, obs=olog)
+        ss = StrategySearch(shell, machine=new_machine, obs=olog,
+                            objective=objective)
         warm = old_strategy if old_strategy is not None \
             and len(old_strategy) else None
         warm_fb = fallback_strategy if fallback_strategy is not None \
@@ -353,12 +360,12 @@ def research_strategy(config, rebuild, new_machine, old_strategy,
                           "best_time_s": info.get("best_time"),
                           "iters": info.get("iters_done"),
                           "budget_hit": info.get("budget_hit", False),
-                          "budget_s": budget}
+                          "budget_s": budget, "objective": objective}
     except Exception as e:
         log(f"elastic: surviving-mesh re-search unavailable ({e}); "
             f"continuing pure-DP on {new_machine.num_devices} devices")
         return Strategy(), {"mode": "dp_fallback", "error": str(e),
-                            "budget_s": budget}
+                            "budget_s": budget, "objective": objective}
 
 
 def recover(model, sig: DeviceLossDetected, rebuild, olog=None,

@@ -92,15 +92,24 @@ def _per_device_out_tiles(op: Op, pc: ParallelConfig,
 
 def device_memory_report(model, strategy=None, machine=None, *,
                          hbm_capacity: Optional[float] = None,
-                         donated: bool = True) -> dict:
+                         donated: bool = True,
+                         forward_only: bool = False,
+                         kv_cache_bytes: float = 0.0) -> dict:
     """Predict each device's peak resident HBM bytes for ``model`` under
     ``strategy`` (op name -> ParallelConfig overrides; None = the pcs
     the model was built with).
 
+    ``forward_only=True`` prices the SERVING residency instead of the
+    training step: no optimizer state, no gradient cotangents, and the
+    activation term drops to factor 1.0 — nothing is saved for a
+    backward, only the live inter-op tiles — while ``kv_cache_bytes``
+    (per device, from serve/kv_cache.py) is added as its own bucket.
+
     Returns ``{"per_device": {dev: {params, opt, grads, activations,
-    inputs, total}}, "capacity": bytes, "over": [(dev, total), ...],
-    "assumptions": {...}}`` — ``over`` lists devices whose predicted
-    peak exceeds ``hbm_capacity`` (default: the TpuChipPerf capacity).
+    inputs, kv_cache, total}}, "capacity": bytes, "over": [(dev, total),
+    ...], "assumptions": {...}}`` — ``over`` lists devices whose
+    predicted peak exceeds ``hbm_capacity`` (default: the TpuChipPerf
+    capacity).
     """
     from flexflow_tpu.sim.cost_model import TpuChipPerf
 
@@ -114,8 +123,9 @@ def device_memory_report(model, strategy=None, machine=None, *,
     if hbm_capacity is None:
         hbm_capacity = TpuChipPerf().hbm_capacity
 
+    act_factor = 1.0 if forward_only else ACTIVATION_FACTOR
     zero = {"params": 0.0, "opt": 0.0, "grads": 0.0,
-            "activations": 0.0, "inputs": 0.0}
+            "activations": 0.0, "inputs": 0.0, "kv_cache": 0.0}
     per: Dict[int, Dict[str, float]] = {d: dict(zero) for d in range(n_dev)}
 
     seen_param_keys = set()
@@ -130,12 +140,16 @@ def device_memory_report(model, strategy=None, machine=None, *,
             # holding (a replica of) one shard-fraction of the param
             for d in range(n_dev):
                 per[d]["params"] += pb * pscale * frac
-                per[d]["opt"] += pb * frac * (2.0 if mixed else 1.0)
-                per[d]["grads"] += pb * pscale * frac
-        # -- activation residual (saved for backward) ------------------
+                if not forward_only:
+                    per[d]["opt"] += pb * frac * (2.0 if mixed else 1.0)
+                    per[d]["grads"] += pb * pscale * frac
+        # -- activation residual (saved for backward; forward-only keeps
+        # just the live inter-op tiles) --------------------------------
         for d, elems in _per_device_out_tiles(op, pc, n_dev).items():
-            per[d]["activations"] += (elems * act_bytes
-                                      * ACTIVATION_FACTOR)
+            per[d]["activations"] += elems * act_bytes * act_factor
+    if forward_only and kv_cache_bytes:
+        for d in range(n_dev):
+            per[d]["kv_cache"] += float(kv_cache_bytes)
     # -- batch shards --------------------------------------------------
     for t in getattr(model, "_inputs", []):
         shard = math.ceil(t.size() / max(n_dev, 1)) * dtype_bytes(t.dtype)
@@ -161,9 +175,11 @@ def device_memory_report(model, strategy=None, machine=None, *,
                                    "float32"),
             "param_byte_scale": pscale,
             "activation_dtype_bytes": act_bytes,
-            "activation_factor": ACTIVATION_FACTOR,
+            "activation_factor": act_factor,
             "donated": donated,
-            "opt_levels": 2 if mixed else 1,
+            "opt_levels": 0 if forward_only else (2 if mixed else 1),
+            "forward_only": forward_only,
+            "kv_cache_bytes_per_device": float(kv_cache_bytes),
         },
     }
 
@@ -175,10 +191,12 @@ def format_over_report(report: dict) -> str:
     cap = report["capacity"]
     for dev, total in report["over"]:
         b = report["per_device"][dev]
+        kv = b.get("kv_cache", 0.0)
+        kv_part = f" + kv_cache {kv / 1e9:.2f}" if kv else ""
         lines.append(
             f"device {dev}: predicted peak {total / 1e9:.2f} GB exceeds "
             f"{cap / 1e9:.2f} GB HBM (params {b['params'] / 1e9:.2f} + "
             f"opt {b['opt'] / 1e9:.2f} + grads {b['grads'] / 1e9:.2f} + "
             f"activations {b['activations'] / 1e9:.2f} + inputs "
-            f"{b['inputs'] / 1e9:.2f} GB)")
+            f"{b['inputs'] / 1e9:.2f}{kv_part} GB)")
     return "\n".join(lines)

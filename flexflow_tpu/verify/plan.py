@@ -455,12 +455,36 @@ def plan_findings(model, strategy=None, machine=None, *,
         findings.extend(pipeline_findings(pp, model, machine,
                                           where_prefix=where_prefix))
 
+    # a SERVING strategy (apps/search.py --serve stamps
+    # __predicted__.objective == "latency") is vetted forward-only: no
+    # optimizer state or gradient cotangents in the peak, activation
+    # factor 1.0, and the KV cache charged per device
+    pred = getattr(strategy, "predicted", None) if strategy is not None \
+        else None
+    serving = bool(pred) and pred.get("objective") == "latency"
+    kv_bytes = 0.0
+    if serving:
+        kv_bytes = float((pred.get("serve") or {})
+                         .get("kv_cache_bytes_per_device", 0.0))
+        if not kv_bytes:
+            from flexflow_tpu.serve.kv_cache import kv_cache_bytes
+
+            batch = (pred.get("serve") or {}).get("max_batch") \
+                or getattr(getattr(model, "config", None),
+                           "batch_size", 1)
+            kv_bytes = float(kv_cache_bytes(model, batch,
+                                            strategy=strategy))
+
     mem = None
     if check_memory:
         mem = device_memory_report(model, strategy, machine,
-                                   hbm_capacity=hbm_capacity)
+                                   hbm_capacity=hbm_capacity,
+                                   forward_only=serving,
+                                   kv_cache_bytes=kv_bytes)
         for dev, total in mem["over"]:
             b = mem["per_device"][dev]
+            kv = b.get("kv_cache", 0.0)
+            kv_part = f" + kv_cache {kv / 1e9:.2f}" if kv else ""
             findings.append(_f(
                 "oom", where_prefix + f"device{dev}",
                 f"predicted peak {total / 1e9:.2f} GB exceeds "
@@ -468,7 +492,7 @@ def plan_findings(model, strategy=None, machine=None, *,
                 f"{b['params'] / 1e9:.2f} + opt {b['opt'] / 1e9:.2f} + "
                 f"grads {b['grads'] / 1e9:.2f} + activations "
                 f"{b['activations'] / 1e9:.2f} + inputs "
-                f"{b['inputs'] / 1e9:.2f} GB)"))
+                f"{b['inputs'] / 1e9:.2f}{kv_part} GB)"))
 
     by_code: Dict[str, int] = {}
     for f in findings:
@@ -480,6 +504,9 @@ def plan_findings(model, strategy=None, machine=None, *,
         "by_code": by_code,
         "allow_degraded": allow_degraded,
     }
+    if serving:
+        summary["serving"] = {"forward_only": True,
+                              "kv_cache_bytes_per_device": kv_bytes}
     if mem is not None:
         peak = max((b["total"] for b in mem["per_device"].values()),
                    default=0.0)

@@ -99,6 +99,83 @@ def test_close_unblocks_full_queue_worker(machine8):
         next(p)
 
 
+def test_serving_variable_final_batch(machine8):
+    """The serving forward-only path: batch_requests' zero-padded final
+    group flows through the prefetcher as a full rectangle, FIFO order
+    preserved against the host-side member lists."""
+    from flexflow_tpu.serve.batcher import batch_requests
+    from flexflow_tpu.serve.loadgen import synthetic_requests
+
+    reqs = synthetic_requests(20, seed=3, rate_qps=1000.0, vocab_size=64,
+                              prompt_len=4)
+    members_seen = []
+
+    def gen():
+        for batch, members in batch_requests(iter(reqs), 8,
+                                             pad_shape=(4,),
+                                             dtype=np.int32):
+            members_seen.append(members)
+            yield (batch,)
+
+    with DevicePrefetcher(gen(), machine=machine8, depth=2) as p:
+        out = [np.asarray(b[0]) for b in p]
+    assert [len(m) for m in members_seen] == [8, 8, 4]
+    assert all(o.shape == (8, 4) for o in out)
+    assert (out[-1][4:] == 0).all()  # padded rows of the final group
+    for batch, members in zip(out, members_seen):
+        for i, r in enumerate(members):
+            assert (batch[i] == r.tokens).all()  # FIFO determinism
+
+
+def test_serving_empty_queue_clean_stop(machine8):
+    """An empty request queue yields no batches: the wrapped prefetcher
+    raises a clean StopIteration and the worker exits."""
+    from flexflow_tpu.serve.batcher import batch_requests
+
+    def gen():
+        for batch, _ in batch_requests(iter([]), 8, pad_shape=(4,),
+                                       dtype=np.int32):
+            yield (batch,)
+
+    p = DevicePrefetcher(gen(), machine=machine8, depth=1)
+    with pytest.raises(StopIteration):
+        next(p)
+    assert not p._thread.is_alive()
+    assert p.batches == 0
+
+
+def test_serving_slot_reclaim_determinism_with_staged_admissions(
+        machine8):
+    """Slot assignment under staggered reclaim is a pure function of the
+    arrival stream — run the same continuous-batching schedule twice and
+    require identical (rid -> slot) histories."""
+    from flexflow_tpu.serve.batcher import ContinuousBatcher, RequestQueue
+    from flexflow_tpu.serve.loadgen import synthetic_requests
+
+    def schedule():
+        reqs = synthetic_requests(10, seed=11, rate_qps=200.0,
+                                  vocab_size=64, prompt_len=3,
+                                  max_new_tokens=2)
+        for i, r in enumerate(reqs):
+            r.max_new_tokens = 1 + (i % 3)  # staggered completions
+        q = RequestQueue(reqs)
+        b = ContinuousBatcher(max_batch=4, max_len=16)
+        history, vnow = [], 0.0
+        while q.pending() or b.num_active():
+            for slot in b.admit(q, vnow):
+                history.append(("admit", b.slots[slot].req.rid, slot))
+            for i, _ in b.active():
+                b.record_token(i, 7)
+            vnow += 0.05
+            for slot, req in b.reclaim(vnow):
+                history.append(("reclaim", req.rid, slot))
+        return history
+
+    first, second = schedule(), schedule()
+    assert first == second
+    assert len([h for h in first if h[0] == "reclaim"]) == 10
+
+
 def test_depth_validation():
     with pytest.raises(ValueError):
         DevicePrefetcher(iter(()), machine=None, depth=0)

@@ -1,0 +1,483 @@
+"""Serving runtime (serve/): loadgen determinism, the request queue and
+continuous batcher's slot contracts, KV-cache layout/bytes/ring
+semantics, forward-only memory pricing, the latency search objective,
+the decode engine (batched-vs-single equivalence, autoscale lifecycle,
+drain), and the serve_request / serve_batch / serve_resize /
+serve_summary obs records through report + summarize."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.serve.batcher import (ContinuousBatcher, RequestQueue,
+                                        batch_requests)
+from flexflow_tpu.serve.kv_cache import (KVCache, KVCacheLayout,
+                                         kv_cache_bytes)
+from flexflow_tpu.serve.loadgen import Request, synthetic_requests
+
+
+@pytest.fixture(scope="module")
+def tiny_lm(machine8):
+    """One tiny causal GPT (the smoke geometry) shared by the engine
+    tests — built once, jit shared across engines."""
+    from flexflow_tpu.apps.serve import _build_lm
+
+    return _build_lm(machine8, batch=8, seed=0, tiny=True,
+                     research_budget_s=0.5)
+
+
+# ---------------------------------------------------------------------------
+# loadgen
+
+
+def test_loadgen_deterministic_and_gapped():
+    a = synthetic_requests(8, seed=7, rate_qps=50.0, prompt_len=4)
+    b = synthetic_requests(8, seed=7, rate_qps=50.0, prompt_len=4)
+    assert [r.arrival_v for r in a] == [r.arrival_v for r in b]
+    assert all((x.tokens == y.tokens).all() for x, y in zip(a, b))
+    assert all(a[i].arrival_v < a[i + 1].arrival_v for i in range(7))
+    # prompts never collide with pad (0) or the conventional EOS (1)
+    assert all((r.tokens >= 2).all() for r in a)
+    g = synthetic_requests(8, seed=7, rate_qps=50.0, prompt_len=4,
+                           gap_after=4, gap_s=100.0)
+    assert g[4].arrival_v - g[3].arrival_v > 100.0
+    assert [r.arrival_v for r in g[:4]] == [r.arrival_v for r in a[:4]]
+
+
+def test_loadgen_validation():
+    with pytest.raises(ValueError):
+        synthetic_requests(-1)
+    with pytest.raises(ValueError):
+        synthetic_requests(1, rate_qps=0.0)
+
+
+# ---------------------------------------------------------------------------
+# queue + continuous batcher
+
+
+def _req(rid, arrival, tokens=(2, 3), max_new=2, eos=-1):
+    return Request(rid=rid, arrival_v=arrival,
+                   tokens=np.asarray(tokens, np.int32),
+                   max_new_tokens=max_new, eos_id=eos)
+
+
+def test_request_queue_order_depth_drain():
+    q = RequestQueue([_req(1, 2.0), _req(0, 1.0)])
+    q.push(_req(2, 0.5))  # out-of-order push re-sorts
+    assert q.next_arrival() == 0.5
+    assert q.depth(1.5) == 2 and q.pending() == 3
+    got = q.pop_ready(1.5, 5)
+    assert [r.rid for r in got] == [2, 0]
+    rest = q.drain()
+    assert [r.rid for r in rest] == [1] and q.pending() == 0
+
+
+def test_batcher_slot_assignment_is_deterministic():
+    """Free slots fill ascending by queue order and reclaim ascending —
+    the slot of every request is a pure function of the arrival stream."""
+    q = RequestQueue([_req(i, 0.0, max_new=1 + (i % 2)) for i in range(6)])
+    b = ContinuousBatcher(max_batch=4, max_len=8)
+    assert b.admit(q, 0.0) == [0, 1, 2, 3]
+    for i, _ in b.active():
+        b.record_token(i, 9)
+    done = b.reclaim(1.0)
+    # max_new=1 for even rids -> slots 0 and 2 free first, in order
+    assert [(i, r.rid) for i, r in done] == [(0, 0), (2, 2)]
+    assert b.admit(q, 1.0) == [0, 2]
+    assert sorted(s.req.rid for _, s in b.active()) == [1, 3, 4, 5]
+    assert done[0][1].reply == [9] and done[0][1].done_v == 1.0
+
+
+def test_batcher_eos_and_window_reclaim():
+    b = ContinuousBatcher(max_batch=2, max_len=4)
+    q = RequestQueue([_req(0, 0.0, max_new=99, eos=1),
+                      _req(1, 0.0, max_new=99)])
+    b.admit(q, 0.0)
+    b.record_token(0, 1)          # EOS finishes slot 0
+    b.record_token(1, 5)
+    assert [i for i, _ in b.reclaim(1.0)] == [0]
+    b.record_token(1, 6)          # fills to max_len -> window reclaim
+    assert [i for i, _ in b.reclaim(2.0)] == [1]
+    with pytest.raises(ValueError):
+        b.record_token(0, 7)      # freed slot is not generating
+
+
+def test_batcher_rejects_overlong_prompt():
+    b = ContinuousBatcher(max_batch=1, max_len=3)
+    q = RequestQueue([_req(0, 0.0, tokens=(2, 3, 4))])
+    with pytest.raises(ValueError, match="no room to generate"):
+        b.admit(q, 0.0)
+
+
+def test_token_matrix_rectangle_and_padding():
+    b = ContinuousBatcher(max_batch=3, max_len=5)
+    q = RequestQueue([_req(0, 0.0, tokens=(4, 5, 6))])
+    b.admit(q, 0.0)
+    m = b.token_matrix(pad_id=0)
+    assert m.shape == (3, 5) and m.dtype == np.int32
+    assert list(m[0]) == [4, 5, 6, 0, 0]
+    assert (m[1:] == 0).all()     # inactive slots are all-pad rows
+
+
+def test_batch_requests_pads_final_group():
+    reqs = [_req(i, 0.0, tokens=[2 + i] * 3) for i in range(5)]
+    out = list(batch_requests(iter(reqs), 2, pad_shape=(4,),
+                              dtype=np.int32))
+    assert [len(m) for _, m in out] == [2, 2, 1]
+    last, members = out[-1]
+    assert last.shape == (2, 4)
+    assert list(last[0]) == [6, 6, 6, 0]  # sample padded up to shape
+    assert (last[1] == 0).all()           # absent row zero-padded
+    assert list(batch_requests(iter([]), 2)) == []
+    with pytest.raises(ValueError):
+        list(batch_requests(iter(reqs), 0))
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+
+
+def test_kv_layout_bytes_and_sharding():
+    lay = KVCacheLayout(num_layers=2, num_heads=4, head_dim=8,
+                        max_batch=8, max_seq=16)
+    # 2 (K+V) * L * B * H * S * hd * 4 bytes
+    assert lay.total_bytes() == 2 * 2 * 8 * 4 * 16 * 8 * 4
+    sharded = KVCacheLayout(num_layers=2, num_heads=4, head_dim=8,
+                            max_batch=8, max_seq=16,
+                            s_parts=2, h_parts=2, n_parts=2)
+    assert sharded.bytes_per_device() == lay.total_bytes() // 8
+    bf16 = KVCacheLayout(num_layers=2, num_heads=4, head_dim=8,
+                         max_batch=8, max_seq=16, dtype="bfloat16")
+    assert bf16.total_bytes() == lay.total_bytes() // 2
+    assert lay.describe()["grid"] == [1, 1, 1]
+
+
+def test_kv_layout_from_model_and_bytes(tiny_lm):
+    model, _ = tiny_lm
+    lay = KVCacheLayout.from_model(model, max_batch=8)
+    assert lay is not None
+    assert lay.num_layers == 2 and lay.max_seq == 16
+    assert kv_cache_bytes(model, 8) == lay.bytes_per_device() > 0
+
+
+def test_kv_cache_ring_read_reclaim():
+    lay = KVCacheLayout(num_layers=1, num_heads=2, head_dim=3,
+                        max_batch=2, max_seq=4)
+    c = KVCache(lay)
+    for pos in range(6):  # wraps the 4-row ring
+        c.write(0, 0, pos, np.full((2, 3), pos, np.float32),
+                np.full((2, 3), 10 + pos, np.float32))
+    k, v = c.read(0, 0)
+    # oldest surviving entries first: positions 2..5
+    assert [int(k[i, 0, 0]) for i in range(4)] == [2, 3, 4, 5]
+    assert [int(v[i, 0, 0]) for i in range(4)] == [12, 13, 14, 15]
+    c.reclaim(0)
+    assert int(c.lengths[0]) == 0 and (c.k[0, 0] == 0).all()
+
+
+def test_engine_fills_cache_exactly(tiny_lm, machine8):
+    """The engine's cache fill must equal the attention op's own K/V
+    projection of the same inputs — exact by construction."""
+    from flexflow_tpu.serve.engine import ServeEngine
+
+    model, _ = tiny_lm
+    eng2 = ServeEngine(model, None, log=lambda *a: None)
+    r = synthetic_requests(1, seed=5, rate_qps=1000.0, vocab_size=64,
+                           prompt_len=3, max_new_tokens=99)[0]
+    r.arrival_v = 0.0  # admit immediately
+    # drive one step by hand, then inspect the cache mid-flight
+    q = RequestQueue([r])
+    b = ContinuousBatcher(eng2.max_batch, eng2.max_len)
+    b.admit(q, 0.0)
+    active = b.active()
+    pre = {i: s.length for i, s in active}
+    tokens = b.token_matrix(0)
+    outs = eng2._predict(eng2.params, eng2.state, tokens,
+                         *eng2._zero_extra_inputs())
+    eng2._fill_kv(outs[1:], active, pre)
+    x = np.asarray(outs[1]).astype(np.float32)  # first layer's attn input
+    wk, _ = eng2._kv_w[0]
+    h, hd = eng2.kv_layout.num_heads, eng2.kv_layout.head_dim
+    want = (x[0, :3, :] @ wk).reshape(3, h, hd)
+    got_k, _ = eng2.kv_cache.read(0, 0)
+    np.testing.assert_allclose(got_k, want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# forward-only memory pricing + plan vetting
+
+
+def test_forward_only_memory_report(tiny_lm):
+    from flexflow_tpu.verify.memory import device_memory_report
+
+    model, _ = tiny_lm
+    train = device_memory_report(model)
+    serve = device_memory_report(model, forward_only=True,
+                                 kv_cache_bytes=12345.0)
+    for d, bucket in serve["per_device"].items():
+        assert bucket["opt"] == 0.0 and bucket["grads"] == 0.0
+        assert bucket["kv_cache"] == 12345.0
+        assert bucket["total"] < train["per_device"][d]["total"]
+    assert serve["assumptions"]["forward_only"] is True
+    assert serve["assumptions"]["activation_factor"] == 1.0
+    assert serve["assumptions"]["kv_cache_bytes_per_device"] == 12345.0
+    assert train["per_device"][0]["kv_cache"] == 0.0
+
+
+def test_plan_vets_serving_strategy(tiny_lm, machine8):
+    """A strategy whose __predicted__ block says objective=latency is
+    priced forward-only with the KV cache charged, and the summary
+    carries the serving block."""
+    from flexflow_tpu.strategy import Strategy
+    from flexflow_tpu.verify.plan import plan_findings
+
+    model, _ = tiny_lm
+    s = Strategy()
+    s.predicted = {"objective": "latency",
+                   "serve": {"max_batch": 8,
+                             "kv_cache_bytes_per_device":
+                                 float(kv_cache_bytes(model, 8))}}
+    findings, summary = plan_findings(model, s, machine8)
+    assert not [f for f in findings if f.severity == "error"], findings
+    assert summary["serving"]["forward_only"] is True
+    assert summary["serving"]["kv_cache_bytes_per_device"] > 0
+    # a training strategy carries no serving block
+    _, base = plan_findings(model, Strategy(), machine8)
+    assert "serving" not in base
+
+
+# ---------------------------------------------------------------------------
+# latency search objective
+
+
+def test_latency_objective_threads_through_research(tiny_lm, machine8):
+    from flexflow_tpu.utils.elastic import research_strategy
+
+    model, rebuild = tiny_lm
+    strategy, info = research_strategy(
+        model.config, rebuild, machine8, None, log=lambda *a: None,
+        objective="latency")
+    assert info["objective"] == "latency"
+    assert strategy is not None
+
+
+def test_search_rejects_unknown_objective(tiny_lm, machine8):
+    from flexflow_tpu.sim.search import StrategySearch
+
+    model, _ = tiny_lm
+    with pytest.raises(ValueError, match="objective"):
+        StrategySearch(model, machine8, objective="bogus")
+
+
+# ---------------------------------------------------------------------------
+# engine: decode service, equivalence, lifecycle, drain, obs, metrics
+
+
+def test_engine_serves_all_and_emits_records(tiny_lm, tmp_path):
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs.metrics import read_textfile, MetricsExporter
+    from flexflow_tpu.obs.report import summarize
+    from flexflow_tpu.serve.engine import ServeEngine
+
+    model, _ = tiny_lm
+    olog = obs.RunLog(str(tmp_path / "serve.jsonl"), surface="serve")
+    metrics = MetricsExporter(str(tmp_path / "metrics.prom"))
+    eng = ServeEngine(model, None, olog=olog, metrics=metrics,
+                      log=lambda *a: None)
+    reqs = synthetic_requests(10, seed=2, rate_qps=500.0, vocab_size=64,
+                              prompt_len=4, max_new_tokens=3)
+    summary = eng.run(reqs)
+    olog.close()
+    assert summary["completed"] == 10 and summary["unserved"] == 0
+    assert summary["dropped"] == 0
+    assert math.isfinite(summary["p50_s"]) and math.isfinite(
+        summary["p99_s"])
+    assert all(r.reply and r.done_v is not None for r in reqs)
+    events = list(obs.read_run(olog.path))
+    kinds = {e["kind"] for e in events}
+    assert {"serve_request", "serve_batch", "serve_summary"} <= kinds
+    assert len([e for e in events
+                if e["kind"] == "serve_request"]) == 10
+    sv = summarize(events)["serve"]
+    assert sv["summary"]["completed"] == 10
+    assert sv["latency_s"]["n"] == 10
+    gauges = read_textfile(str(tmp_path / "metrics.prom"))
+    assert gauges["requests_total"] == 10.0
+    assert gauges["qps"] > 0 and math.isfinite(gauges["latency_p99_s"])
+
+
+def test_summarize_tolerates_stepless_serving_run():
+    """A pure serving stream has no `step` records — summarize must not
+    require them (satellite: obs tolerant of training-free runs)."""
+    from flexflow_tpu.obs.report import summarize
+
+    events = [{"kind": "run_start", "ts": 0.0},
+              {"kind": "serve_summary", "ts": 1.0, "requests": 1,
+               "completed": 1, "unserved": 0, "dropped": 0, "qps": 1.0,
+               "p50_s": 0.01, "p99_s": 0.01, "steps": 2, "resizes": 0,
+               "virtual_s": 1.0, "drained": False, "devices": 8}]
+    out = summarize(events)
+    assert out["serve"]["summary"]["dropped"] == 0
+    assert "steps" not in out or not out.get("steps")
+
+
+def test_report_serve_renders_and_json(tmp_path):
+    from flexflow_tpu import obs
+    from flexflow_tpu.apps.report import serve_main
+
+    olog = obs.RunLog(str(tmp_path / "r.jsonl"), surface="serve")
+    olog.event("serve_request", rid=0, latency_s=0.02, arrival_v=0.0,
+               admit_v=0.0, done_v=0.02, prompt_len=4, new_tokens=2,
+               wall_s=0.001)
+    olog.event("serve_batch", step=1, vnow=0.02, active=1, admitted=1,
+               queue_depth=0, devices=8)
+    olog.event("serve_resize", direction="shrink", from_devices=8,
+               to_devices=6, step=1, vnow=0.02, queue_depth=0,
+               idle_streak=3, research_s=0.01,
+               research={"mode": "mcmc"}, total_s=0.05)
+    olog.event("serve_summary", requests=1, completed=1, unserved=0,
+               dropped=0, qps=50.0, p50_s=0.02, p99_s=0.02, steps=1,
+               resizes=1, virtual_s=0.02, drained=False, devices=6)
+    olog.close()
+    lines = []
+    rc = serve_main([str(tmp_path)], log=lines.append)
+    assert rc == 0
+    text = "\n".join(lines)
+    assert "== serving ==" in text and "latency histogram" in text
+    assert "serve_resize[shrink]: 8 -> 6" in text
+    out = []
+    rc = serve_main([str(tmp_path), "--json"], log=out.append)
+    assert rc == 0
+    blob = json.loads(out[-1])
+    assert blob["summary"]["completed"] == 1
+    assert blob["resizes"][0]["direction"] == "shrink"
+    # a stream with no serve records exits 1
+    empty = obs.RunLog(str(tmp_path / "empty" / "e.jsonl"))
+    empty.event("step", step=1)
+    empty.close()
+    assert serve_main([str(tmp_path / "empty")],
+                      log=lambda *a: None) == 1
+
+
+def test_batched_replies_equal_single(tiny_lm, machine8):
+    """Batching on vs off is invisible in the replies (the smoke's
+    equivalence contract, pinned at test scale): the same requests
+    served through the 8-slot batch and one-at-a-time on a single
+    device produce bit-identical token sequences."""
+    from flexflow_tpu.apps.serve import _build_lm
+    from flexflow_tpu.serve.engine import ServeEngine
+
+    model8, _ = tiny_lm
+    eng8 = ServeEngine(model8, None, log=lambda *a: None)
+    reqs = synthetic_requests(3, seed=6, rate_qps=1000.0, vocab_size=64,
+                              prompt_len=4, max_new_tokens=2)
+    eng8.run(reqs)
+    batched = {r.rid: list(r.reply) for r in reqs}
+
+    m1 = machine8.shrink([0])
+    model1, _ = _build_lm(m1, batch=1, seed=0, tiny=True)
+    eng1 = ServeEngine(model1, None, log=lambda *a: None)
+    reqs1 = synthetic_requests(3, seed=6, rate_qps=1000.0, vocab_size=64,
+                               prompt_len=4, max_new_tokens=2)
+    eng1.run(reqs1)
+    single = {r.rid: list(r.reply) for r in reqs1}
+    assert batched == single
+
+
+def test_autoscale_lifecycle_and_serve_resize_records(machine8,
+                                                      tmp_path):
+    """Gap-then-burst load: exactly one idle-watermark shrink and one
+    queue-depth grow, each a serve_resize record, and every request
+    still served."""
+    from flexflow_tpu import obs
+    from flexflow_tpu.apps.serve import _build_lm
+    from flexflow_tpu.serve.engine import ServeEngine
+
+    model, rebuild = _build_lm(machine8, batch=8, seed=0, tiny=True,
+                               research_budget_s=0.5)
+    olog = obs.RunLog(str(tmp_path / "scale.jsonl"), surface="serve")
+    eng = ServeEngine(model, rebuild, olog=olog, log=lambda *a: None,
+                      queue_hi=3, idle_boundaries=3, shrink_to=4)
+    early = synthetic_requests(3, seed=0, rate_qps=500.0, vocab_size=64,
+                               prompt_len=4, max_new_tokens=2)
+    burst = synthetic_requests(12, seed=1, rate_qps=2000.0,
+                               vocab_size=64, prompt_len=4,
+                               max_new_tokens=2,
+                               start_v=early[-1].arrival_v + 30.0)
+    for i, r in enumerate(burst):
+        r.rid = 100 + i
+    summary = eng.run(early + burst)
+    olog.close()
+    dirs = [(r["direction"], r["from_devices"], r["to_devices"])
+            for r in eng.resizes]
+    assert dirs == [("shrink", 8, 4), ("grow", 4, 8)]
+    assert summary["completed"] == 15 and summary["dropped"] == 0
+    assert summary["devices"] == 8
+    recs = [e for e in obs.read_run(olog.path)
+            if e["kind"] == "serve_resize"]
+    assert [(r["direction"], r["from_devices"], r["to_devices"])
+            for r in recs] == dirs
+    assert all(r["research"]["mode"] for r in recs)
+
+
+def test_drain_finishes_inflight_and_reports_unserved(tiny_lm):
+    """The drain contract: requested mid-run, admission stops, in-flight
+    requests finish, queued requests come back unserved (never
+    dropped)."""
+    from flexflow_tpu.serve.engine import ServeEngine
+
+    model, _ = tiny_lm
+    eng = ServeEngine(model, None, log=lambda *a: None)
+    reqs = synthetic_requests(4, seed=8, rate_qps=1000.0, vocab_size=64,
+                              prompt_len=4, max_new_tokens=2)
+    late = synthetic_requests(4, seed=9, rate_qps=1000.0, vocab_size=64,
+                              prompt_len=4, max_new_tokens=2,
+                              start_v=1000.0)
+    for i, r in enumerate(late):
+        r.rid = 50 + i
+    drain = {"requested": True}  # pre-armed: drains on the first check
+    summary = eng.run(reqs + late, drain=drain)
+    assert summary["drained"] is True
+    assert summary["completed"] == 0 and summary["unserved"] == 8
+    assert summary["dropped"] == 0
+
+    # requested after in-flight work exists: those requests finish
+    eng2 = ServeEngine(model, None, log=lambda *a: None)
+    drain2 = {}
+    orig = eng2._predict
+
+    def predict_then_drain(*a, **kw):
+        drain2["requested"] = True
+        return orig(*a, **kw)
+
+    eng2._predict = predict_then_drain
+    reqs2 = synthetic_requests(2, seed=8, rate_qps=1000.0, vocab_size=64,
+                               prompt_len=4, max_new_tokens=2)
+    for r in reqs2:
+        r.arrival_v = 0.0  # both in flight before the drain lands
+    late2 = synthetic_requests(2, seed=9, rate_qps=1000.0, vocab_size=64,
+                               prompt_len=4, max_new_tokens=2,
+                               start_v=1000.0)
+    for i, r in enumerate(late2):
+        r.rid = 50 + i
+    s2 = eng2.run(reqs2 + late2, drain=drain2)
+    assert s2["completed"] == 2 and s2["unserved"] == 2
+    assert all(r.reply for r in reqs2)
+
+
+def test_forward_only_service_cnn_shapes(tiny_lm, machine8):
+    """run_forward pads variable final groups and rides request metadata
+    host-side in FIFO order through the DevicePrefetcher."""
+    from flexflow_tpu.serve.engine import ServeEngine
+
+    model, _ = tiny_lm
+    eng = ServeEngine(model, None, log=lambda *a: None)
+    reqs = synthetic_requests(11, seed=3, rate_qps=1000.0, vocab_size=64,
+                              prompt_len=16, max_new_tokens=0)
+    summary = eng.run_forward(reqs)
+    assert summary["completed"] == 11 and summary["steps"] == 2
+    assert all(r.reply is not None for r in reqs)
+    assert all(r.done_v is not None and r.done_v > r.arrival_v
+               for r in reqs)
