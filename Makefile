@@ -3,7 +3,7 @@ PYTHON ?= python
 
 .PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
 	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke \
-	serve-smoke
+	serve-smoke fleet-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -17,7 +17,7 @@ native:
 # every emitted obs record kind must be rendered by obs/report.py and
 # covered by a test (tools/check_obs_kinds.py), and the static strategy
 # verifier must come up clean (lint)
-check: lint fusion-smoke serve-smoke
+check: lint fusion-smoke serve-smoke fleet-smoke
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
@@ -156,6 +156,29 @@ serve-smoke:
 	assert rec['devices'] == 8, rec; \
 	print('serve-smoke ok:', {k: rec[k] for k in \
 	('completed','qps','p50_s','p99_s','resizes','devices')})"
+
+# multi-tenant fleet smoke (fleet/ round): two jobs on the 8-device
+# simulated pool trade devices mid-run — training job A shrinks 6->4
+# while serving job B's queue burst grows it 2->4, then the trade
+# reverses when B's queue drains; asserts both jobs finish with finite
+# bit-sane results, exactly two fleet_rebalance records each followed
+# by its two directed elastic_resize records, zero fault records, and
+# an arbiter packing that reproduces under the fixed seed; stdout is
+# exactly one JSON record, asserted like bench-smoke
+fleet-smoke:
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.fleet --smoke \
+	| $(PYTHON) -c "import json,math,sys; \
+	rec=json.loads(sys.stdin.readline()); \
+	assert sys.stdin.readline() == '', 'stdout must be one JSON line'; \
+	assert rec['rebalances'] == 2, rec; \
+	assert rec['jobs'] == rec['done'] == 2 and rec['failed'] == 0, rec; \
+	assert math.isfinite(rec['train_final_loss']), rec; \
+	assert rec['serve_completed'] == 20 and rec['serve_unserved'] == 0, rec; \
+	print('fleet-smoke ok:', {k: rec[k] for k in \
+	('jobs','done','rebalances','packs','native_prices', \
+	'train_final_loss','serve_completed')})"
 
 # MFU-waterfall smoke (observability): tiny CNN with sampled op timing +
 # live metrics export; asserts the step_budget bucket invariant, a

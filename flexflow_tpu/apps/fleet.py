@@ -1,0 +1,286 @@
+"""Fleet driver — N concurrent train+serve jobs timesharing one device
+pool through the fleet coordinator (fleet/ package).
+
+    python -m flexflow_tpu.apps.fleet --fleet-quantum 2 -obs-dir obs/
+    python -m flexflow_tpu.apps.fleet --smoke
+
+The driver runs the reference two-job mix — a CNN training job next to
+a tiny-GPT serving job — on the full local mesh; the fleet API proper
+(:class:`~flexflow_tpu.fleet.job.JobSpec` /
+:class:`~flexflow_tpu.fleet.coordinator.FleetCoordinator`) is how real
+mixes are composed.  Flags ride FFConfig: ``--fleet-quantum`` (steps
+each running job gets per round-robin turn) and
+``--fleet-search-budget-s`` (wall cap per arbiter pricing re-search),
+plus the shared ``-obs-dir`` / ``-metrics-path`` / ``--seed`` /
+``--iterations``.
+
+stdout carries EXACTLY ONE JSON line —
+
+    {"run_id": ..., "jobs": ..., "done": ..., "failed": ...,
+     "rebalances": ..., "train_final_loss": ..., "serve_completed": ...}
+
+— the same single-record contract bench.py and serve.py hold; all
+narration goes to stderr.  **Drain contract**: SIGTERM/SIGINT makes
+every job wind down at its next boundary (train jobs keep their loss
+history, serve jobs report queued-never-admitted requests unserved) and
+the process EXITS 0.
+
+``--smoke`` (make fleet-smoke) is the deterministic CPU scenario: on
+the 8-device simulated mesh, training job A starts on 6 devices and
+serving job B on 2; B's request burst crosses its queue watermark, the
+arbiter re-packs, A hands two devices to B (A 6->4 while B grows 2->4
+— one ``fleet_rebalance``, two directed ``elastic_resize`` records);
+when B's queue drains the trade reverses (A 4->6, B 4->2).  The smoke
+asserts the exact record sequence, loss continuity and finiteness for
+A, every request served for B, zero fault records anywhere, and that a
+second arbiter reproduces the identical packing under the same seed.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+
+def _err(*a, **kw):
+    print(*a, file=sys.stderr, **kw)
+    sys.stderr.flush()
+
+
+# ---------------------------------------------------------------------------
+# the reference two-job mix
+
+
+def _serve_build(ff_cfg, machine):
+    """The serving job's rebuild factory: the smoke-sized 2-layer GPT
+    (apps/serve.py's ``--tiny`` geometry), reconstructed on whatever
+    slice the coordinator assigns."""
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+
+    cfg_t = TransformerConfig(
+        batch_size=ff_cfg.batch_size, causal=True, seed=ff_cfg.seed,
+        seq_length=16, num_layers=2, d_model=32, num_heads=4, d_ff=128,
+        vocab_size=64)
+    return TransformerLM(cfg_t, machine, ff_cfg.strategies)
+
+
+def _scenario(cfg):
+    """The two JobSpecs of the reference mix: train job A (the
+    elastic-smoke CNN, batch 24 — divisible by every slice size the
+    pool can hand it) and serve job B (tiny GPT, batch 8, queue
+    watermark 4)."""
+    import copy
+
+    from flexflow_tpu.apps.elastic_smoke import _build, _host_batches
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.fleet import JobSpec
+    from flexflow_tpu.serve.loadgen import synthetic_requests
+
+    train_cfg = FFConfig(batch_size=24, input_height=16, input_width=16,
+                         num_iterations=cfg.num_iterations, print_freq=0,
+                         num_classes=8, seed=cfg.seed)
+    job_a = JobSpec(
+        job_id="train-a", kind="train", build=_build, config=train_cfg,
+        payload=_host_batches, priority=1.0, min_devices=2,
+        max_devices=6, search_iters=40)
+
+    serve_cfg = FFConfig(batch_size=8, seed=cfg.seed)
+    early = synthetic_requests(4, seed=cfg.seed, rate_qps=1000.0,
+                               vocab_size=64, prompt_len=4,
+                               max_new_tokens=3)
+    burst = synthetic_requests(16, seed=cfg.seed + 1, rate_qps=5000.0,
+                               vocab_size=64, prompt_len=4,
+                               max_new_tokens=3,
+                               start_v=early[-1].arrival_v + 5.0)
+    for i, r in enumerate(burst):
+        r.rid = 100 + i
+    job_b = JobSpec(
+        job_id="serve-b", kind="serve", build=_serve_build,
+        config=serve_cfg, payload=early + burst, priority=1.0,
+        min_devices=2, max_devices=4, queue_hi=4, search_iters=40)
+    return [job_a, job_b], copy.copy(train_cfg)
+
+
+def fleet_run(cfg, log=_err, pricer=None):
+    """One coordinator run of the reference mix under ``cfg``'s fleet
+    knobs.  Returns ``(summary, coordinator)``."""
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.fleet import FleetCoordinator
+    from flexflow_tpu.obs.metrics import from_config
+    from flexflow_tpu.utils.elastic import drain_scope
+
+    pool = MachineModel()
+    metrics = from_config(cfg, meta={"app": "fleet",
+                                     "pool": pool.num_devices})
+    coord = FleetCoordinator(
+        pool, obs_dir=cfg.obs_dir, metrics=metrics,
+        quantum=cfg.fleet_quantum, budget_s=cfg.fleet_search_budget_s,
+        iters=200, seed=cfg.seed, pricer=pricer, log=log)
+    specs, _ = _scenario(cfg)
+    for spec in specs:
+        coord.submit(spec)
+    with drain_scope(log=log) as drain:
+        summary = coord.run(drain=drain)
+    return summary, coord
+
+
+def _result_line(summary, coord) -> str:
+    """The one stdout JSON line: headline keys first, detail after."""
+    by_state = summary["by_state"]
+    rec = {
+        "run_id": coord.olog.run_id if coord.olog.enabled else None,
+        "pool_devices": summary["pool_devices"],
+        "jobs": len(summary["jobs"]),
+        "done": by_state.get("done", 0),
+        "failed": by_state.get("failed", 0),
+        "rebalances": summary["rebalances"],
+        "packs": summary["packs"],
+        "native_prices": summary["native_prices"],
+        "proxy_prices": summary["proxy_prices"],
+        "wall_s": summary["wall_s"],
+    }
+    for j in summary["jobs"]:
+        if j["kind"] == "train":
+            rec["train_final_loss"] = j.get("final_loss")
+        else:
+            rec["serve_completed"] = j.get("completed")
+            rec["serve_unserved"] = j.get("unserved")
+    return json.dumps(rec)
+
+
+# ---------------------------------------------------------------------------
+# the deterministic --smoke scenario (make fleet-smoke)
+
+
+def _read_stream(path):
+    from flexflow_tpu import obs
+
+    return list(obs.read_run(path))
+
+
+def smoke(cfg, log=_err):
+    """Two jobs trade devices mid-run, both finish bit-sane, and the
+    record sequence is exactly the one the scenario forces."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.device_count() != 8:
+        raise SystemExit(
+            f"fleet --smoke needs the 8-device simulated mesh "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=8), "
+            f"got {jax.device_count()} devices")
+
+    summary, coord = fleet_run(cfg, log=log)
+
+    by_job = {j["job"]: j for j in summary["jobs"]}
+    assert by_job["train-a"]["state"] == "done" \
+        and by_job["serve-b"]["state"] == "done", summary
+    assert summary["rebalances"] == 2, \
+        f"expected exactly 2 rebalances (trade out, trade back): " \
+        f"{summary}"
+
+    # train job A: every loss finite, full iteration count, continuity
+    # across both directed resizes
+    job_a = next(j for j in coord.jobs if j.spec.job_id == "train-a")
+    losses = job_a.result["loss"]
+    assert len(losses) == cfg.num_iterations, \
+        f"A must complete all {cfg.num_iterations} iterations: " \
+        f"{len(losses)}"
+    assert all(math.isfinite(v) for v in losses), losses
+    # serve job B: every request served, none dropped on the floor
+    assert by_job["serve-b"]["completed"] == 20 \
+        and by_job["serve-b"]["unserved"] == 0, by_job["serve-b"]
+
+    # per-stream record sequences (obs_dir/<job_id>/ isolation)
+    a_events = _read_stream(os.path.join(cfg.obs_dir, "train-a",
+                                         "train-a.jsonl"))
+    b_events = _read_stream(os.path.join(cfg.obs_dir, "serve-b",
+                                         "serve-b.jsonl"))
+    fleet_events = _read_stream(os.path.join(cfg.obs_dir,
+                                             "fleet.jsonl"))
+
+    def resizes(events):
+        return [(e["direction"], e["from_devices"], e["to_devices"],
+                 e["cause"]) for e in events
+                if e["kind"] == "elastic_resize"]
+
+    assert resizes(a_events) == [("shrink", 6, 4, "directed"),
+                                 ("grow", 4, 6, "directed")], \
+        f"A resize sequence: {resizes(a_events)}"
+    assert resizes(b_events) == [("grow", 2, 4, "directed"),
+                                 ("shrink", 4, 2, "directed")], \
+        f"B resize sequence: {resizes(b_events)}"
+    # a directed resize is an economy, not a fault: zero fault records
+    for events, who in ((a_events, "A"), (b_events, "B")):
+        faults = [e["kind"] for e in events
+                  if e["kind"] in ("device_loss", "device_return")]
+        assert not faults, f"job {who} has fault records: {faults}"
+
+    # the merged ts-ordering: each fleet_rebalance precedes the two
+    # elastic_resize records it caused
+    merged = sorted(a_events + b_events + fleet_events,
+                    key=lambda e: e["ts"])
+    seq = [e["kind"] for e in merged
+           if e["kind"] in ("fleet_rebalance", "elastic_resize")]
+    assert seq == ["fleet_rebalance", "elastic_resize",
+                   "elastic_resize"] * 2, f"merged sequence: {seq}"
+    kinds = {e["kind"] for e in fleet_events}
+    assert {"fleet_job", "fleet_placement", "fleet_rebalance",
+            "fleet_summary"} <= kinds, kinds
+
+    # mixed-stream summarize (satellite: multi-job obs tolerance)
+    from flexflow_tpu.obs.report import summarize
+
+    s = summarize(merged)
+    assert s.get("fleet", {}).get("rebalances") == 2, s.get("fleet")
+
+    # packing reproducibility: a second arbiter under the same seed,
+    # pricing from scratch, must choose the identical initial packing
+    from flexflow_tpu.fleet import Arbiter, Job
+
+    specs, _ = _scenario(cfg)
+    packs = []
+    for _ in range(2):
+        arb = Arbiter(8, budget_s=cfg.fleet_search_budget_s, iters=200,
+                      seed=cfg.seed, log=lambda *a: None)
+        jobs = [Job(s) for s in specs]
+        packs.append(arb.pack(jobs))
+    assert packs[0] == packs[1], \
+        f"arbiter packing must reproduce under a fixed seed: {packs}"
+
+    log(f"fleet-smoke ok: A {len(losses)} iters (final loss "
+        f"{losses[-1]:.4f}) across 6->4->6 devices, B 20/20 served "
+        f"across 2->4->2, {summary['rebalances']} rebalances, "
+        f"packing reproducible")
+    return summary, coord
+
+
+def main(argv=None, log=_err) -> int:
+    from flexflow_tpu.config import FFConfig
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    is_smoke = "--smoke" in argv
+    cfg = FFConfig.from_args([a for a in argv if a != "--smoke"])
+    if cfg.num_iterations == 10:   # FFConfig default — the mix needs
+        cfg.num_iterations = 48    # A to outlast B's burst
+    if is_smoke and not cfg.obs_dir:
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="ff-fleet-smoke-") as td:
+            cfg.obs_dir = os.path.join(td, "obs")
+            summary, coord = smoke(cfg, log)
+            print(_result_line(summary, coord))
+            return 0
+    if is_smoke:
+        summary, coord = smoke(cfg, log)
+    else:
+        summary, coord = fleet_run(cfg, log)
+    print(_result_line(summary, coord))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

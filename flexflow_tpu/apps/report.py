@@ -55,20 +55,29 @@ import sys
 def _expand_dirs(paths, log):
     """Directory arguments expand to the ``*.jsonl`` streams inside them
     (rotated parts ride along via run_files), so a whole obs dir can be
-    rendered without globbing."""
+    rendered without globbing.  Expansion RECURSES into subdirectories:
+    a fleet run keeps each job's stream in ``obs_dir/<job_id>/``, and
+    ``report <obs_dir>`` must merge the coordinator's records with every
+    job's."""
     import re
 
     out = []
     for p in paths:
         if os.path.isdir(p):
-            found = sorted(
-                os.path.join(p, fn) for fn in os.listdir(p)
-                if fn.endswith(".jsonl"))
+            found = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames.sort()
+                found.extend(
+                    os.path.join(dirpath, fn) for fn in sorted(filenames)
+                    if fn.endswith(".jsonl"))
             if not found:
                 # rotated-only streams: point at each base-numbered part
-                found = sorted(
-                    os.path.join(p, fn) for fn in os.listdir(p)
-                    if re.search(r"\.jsonl\.\d+$", fn))
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames.sort()
+                    found.extend(
+                        os.path.join(dirpath, fn)
+                        for fn in sorted(filenames)
+                        if re.search(r"\.jsonl\.\d+$", fn))
             if not found:
                 log(f"warning: no *.jsonl streams under {p}")
             out.extend(found)

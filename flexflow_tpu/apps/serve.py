@@ -219,7 +219,7 @@ def serve_run(opts, log=_err) -> dict:
     from flexflow_tpu.serve.engine import ServeEngine
     from flexflow_tpu.serve.loadgen import synthetic_requests
     from flexflow_tpu.strategy import Strategy
-    from flexflow_tpu.utils.elastic import install_drain_handler
+    from flexflow_tpu.utils.elastic import drain_scope
     from flexflow_tpu.verify.plan import check_plan
 
     machine = MachineModel()
@@ -259,13 +259,9 @@ def serve_run(opts, log=_err) -> dict:
         max_new_tokens=opts["max_new_tokens"])
     if not decode:
         _forward_payloads(model, requests, opts["seed"])
-    drain = {}
-    restore = install_drain_handler(drain, log=log)
-    try:
+    with drain_scope(log=log) as drain:
         summary = engine.run(requests, drain=drain) if decode \
             else engine.run_forward(requests, drain=drain)
-    finally:
-        restore()
     summary["_olog"] = olog
     olog.close()
     return summary

@@ -213,6 +213,14 @@ class FFConfig:
     max_batch: int = 0
     serve_queue_hi: int = 0
     serve_idle_boundaries: int = 0
+    # fleet coordinator (fleet/ package, apps/fleet.py): --fleet-quantum
+    # is how many steps (train iterations / decode boundaries) each
+    # running job gets per round-robin turn before the coordinator
+    # re-evaluates the packing; --fleet-search-budget-s caps each
+    # arbiter pricing re-search's wall clock (generous by default so
+    # the fixed iteration bound binds and packing stays reproducible)
+    fleet_quantum: int = 4
+    fleet_search_budget_s: float = 30.0
     # static plan analyzer (verify/plan.py, round 12): the drivers fail
     # fast on a strategy whose plan check reports errors; --allow-degraded
     # demotes the promoted degradation diagnostics (replicated/normalized
@@ -335,6 +343,10 @@ class FFConfig:
                 cfg.serve_queue_hi = int(val())
             elif a == "--serve-idle-boundaries":
                 cfg.serve_idle_boundaries = int(val())
+            elif a == "--fleet-quantum":
+                cfg.fleet_quantum = int(val())
+            elif a == "--fleet-search-budget-s":
+                cfg.fleet_search_budget_s = float(val())
             elif a == "--allow-degraded":
                 cfg.allow_degraded = True
             elif a in ("-pallas", "--pallas"):

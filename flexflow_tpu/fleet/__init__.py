@@ -1,0 +1,40 @@
+"""Multi-tenant fleet: many jobs on one device pool, with the strategy
+search as the scheduler (the ROADMAP capstone).
+
+The elastic runtime already speaks a scheduler's language — graceful
+drain exits 0 on SIGTERM, :func:`~flexflow_tpu.utils.elastic.recover` /
+``recover_grow`` resize a live mesh, checkpoints are verified and async.
+This package builds the layer above:
+
+  * :mod:`fleet.job` — :class:`JobSpec` (workload kind, model builder,
+    priority, min/max devices) plus the lifecycle state machine
+    (pending -> placing -> running -> draining -> resized -> done /
+    failed) wrapping the existing training-step machinery and
+    :class:`~flexflow_tpu.serve.engine.ServeEngine`;
+  * :mod:`fleet.arbiter` — placement as search: candidate slice
+    assignments priced per job through the NATIVE simulator
+    (``sim.search.price_on_slice`` — a warm-started budget-capped
+    re-search under the job's objective, makespan for train / latency
+    for serve), with a deterministic DP proxy when the native lib is
+    absent; the chosen packing minimizes weighted predicted cost over
+    the work-conserving (Pareto-maximal) packings;
+  * :mod:`fleet.coordinator` — the event loop: admit jobs onto disjoint
+    ``MachineModel.slice_of`` slices, round-robin each running job a
+    quantum of steps, re-pack when demand shifts, and issue DIRECTED
+    resizes (``utils.elastic.directed_resize`` — the non-fault entry
+    into the elastic machinery) so preemption is a routine economy, not
+    a fault.
+
+Obs kinds: ``fleet_job`` (one per lifecycle transition),
+``fleet_placement`` (one per arbiter packing), ``fleet_rebalance`` (one
+per executed re-packing), ``fleet_summary`` (one per coordinator run).
+Per-job streams live in ``obs_dir/<job_id>/`` so concurrent jobs never
+interleave one run file.  ``apps/fleet.py`` is the driver; ``make
+fleet-smoke`` is the deterministic two-jobs-trade-devices CPU scenario.
+"""
+
+from flexflow_tpu.fleet.arbiter import Arbiter
+from flexflow_tpu.fleet.coordinator import FleetCoordinator
+from flexflow_tpu.fleet.job import Job, JobSpec
+
+__all__ = ["Arbiter", "FleetCoordinator", "Job", "JobSpec"]

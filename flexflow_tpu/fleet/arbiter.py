@@ -1,0 +1,230 @@
+"""Placement arbiter: the strategy search as a device-pool scheduler.
+
+Given the pool size and the fleet's current DEMANDS (each job's
+feasible slice sizes, capped at what it currently bids for), the
+arbiter enumerates candidate packings and picks one.  Two rules order
+the search:
+
+  1. **Work conservation** — only Pareto-MAXIMAL packings compete: a
+     packing is discarded if another feasible packing gives every job at
+     least as many devices and some job strictly more.  A pool with idle
+     devices while a job bids for them is never chosen, which also makes
+     each rebalance's outcome structurally determined when demand tiers
+     leave a single maximal packing (the deterministic smoke relies on
+     exactly this).
+  2. **Weighted predicted cost** — among the maximal packings, minimize
+     ``sum(priority_j * price(job_j, size_j))`` where ``price`` is the
+     job's PREDICTED per-step cost on a slice of that size, from the
+     native simulator via :func:`sim.search.price_on_slice` — a
+     warm-started, budget-capped re-search under the job's objective
+     (step makespan for train, forward-step latency for serve).  When
+     the native library is absent the arbiter degrades to a
+     deterministic DP proxy (cost proportional to ``1/size``), keeping
+     CPU-only CI and the smoke runnable.
+
+Prices are cached per ``(job_id, size)`` — a job's model does not
+change shape between rebalances, so each (job, size) pair is priced at
+most once per coordinator run.  Ties between packings break on the
+lexicographically smallest assignment vector (jobs in admission order),
+so a fixed seed reproduces the identical packing.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class Arbiter:
+    """Prices (job, slice-size) pairs and packs jobs onto the pool.
+
+    ``pricer`` overrides the cost function (tests inject stubs); the
+    default tries the native simulator and falls back to the DP proxy.
+    ``budget_s`` caps each native pricing re-search; ``iters`` bounds
+    its proposals so a fixed seed is deterministic even when the budget
+    never binds."""
+
+    def __init__(self, pool_size: int, *, pricer=None,
+                 budget_s: float = 30.0, iters: int = 200,
+                 seed: int = 0, olog=None, log=print):
+        from flexflow_tpu import obs
+
+        self.pool_size = int(pool_size)
+        self.pricer = pricer
+        self.budget_s = float(budget_s)
+        self.iters = int(iters)
+        self.seed = int(seed)
+        self.olog = olog if olog is not None else obs.NULL
+        self.log = log
+        self._price_cache: Dict[Tuple[str, int], float] = {}
+        self._strategy_cache: Dict[Tuple[str, int], object] = {}
+        self.native_prices = 0
+        self.proxy_prices = 0
+
+    # ------------------------------------------------------------------
+    # pricing
+
+    def price(self, job, size: int) -> float:
+        """Predicted per-step cost of ``job`` on a ``size``-device slice
+        (seconds under the native simulator, dimensionless under the
+        proxy — only relative order within one pricer matters)."""
+        key = (job.spec.job_id, int(size))
+        if key in self._price_cache:
+            return self._price_cache[key]
+        if self.pricer is not None:
+            cost = float(self.pricer(job, size))
+        else:
+            cost = self._price_native(job, size)
+        self._price_cache[key] = cost
+        return cost
+
+    def _price_native(self, job, size: int) -> float:
+        from flexflow_tpu.sim.search import price_on_slice
+
+        spec = job.spec
+        objective = "latency" if spec.kind == "serve" else "makespan"
+        try:
+            cost, strategy, _info = price_on_slice(
+                spec.build, spec.config, size, objective=objective,
+                iters=min(self.iters, spec.search_iters or self.iters),
+                seed=self.seed, warm_strategy=job.strategy,
+                budget_s=self.budget_s)
+            self._strategy_cache[(spec.job_id, int(size))] = strategy
+            self.native_prices += 1
+            return float(cost)
+        except Exception as e:  # native lib absent / sim unavailable
+            self.proxy_prices += 1
+            self.log(f"fleet: native pricing unavailable for "
+                     f"{spec.job_id}@{size} ({type(e).__name__}); "
+                     f"using DP proxy")
+            return self._price_proxy(job, size)
+
+    @staticmethod
+    def _price_proxy(job, size: int) -> float:
+        """Deterministic data-parallel proxy: per-step cost scales as
+        1/size (perfect DP speedup) plus a small per-device sync term so
+        larger slices are never free."""
+        return 1.0 / float(size) + 0.001 * float(size)
+
+    def priced_strategy(self, job, size: int) -> Optional[object]:
+        """The strategy the native pricing search found for this (job,
+        size), if any — handed to ``Job.place`` so the job runs under
+        the plan it was priced with."""
+        return self._strategy_cache.get((job.spec.job_id, int(size)))
+
+    # ------------------------------------------------------------------
+    # packing
+
+    def pack(self, jobs: Sequence, *,
+             current: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+        """Choose a slice size per active job.
+
+        ``jobs`` is the admission-ordered list of jobs to place;
+        ``current`` (job_id -> size) marks sizes already held, used only
+        for the tie-break (prefer the packing closest to the incumbent
+        among equal-cost maximal packings, minimizing churn).  Returns
+        ``{job_id: size}``; a job that cannot fit at its minimum in any
+        feasible packing is assigned 0 (the coordinator queues it)."""
+        jobs = list(jobs)
+        if not jobs:
+            return {}
+        options: List[List[int]] = []
+        for job in jobs:
+            # 0 = "not placed" — always an option so one oversized job
+            # cannot make the whole fleet infeasible
+            options.append([0] + job.candidate_sizes(self.pool_size))
+
+        feasible: List[Tuple[int, ...]] = []
+        for combo in itertools.product(*options):
+            if sum(combo) <= self.pool_size:
+                feasible.append(combo)
+        # Pareto-maximal filter: drop any packing dominated by another
+        # (every job >=, some job >) — work conservation
+        maximal = [c for c in feasible
+                   if not any(d != c and all(x >= y for x, y in
+                                             zip(d, c))
+                              for d in feasible)]
+        if not maximal:
+            maximal = feasible
+
+        cur_vec = tuple((current or {}).get(j.spec.job_id, 0)
+                        for j in jobs)
+
+        def score(combo: Tuple[int, ...]):
+            unplaced = sum(1 for s in combo if s == 0)
+            cost = 0.0
+            for job, size in zip(jobs, combo):
+                if size:
+                    cost += job.spec.priority * self.price(job, size)
+            churn = sum(1 for a, b in zip(combo, cur_vec) if a != b)
+            # placing a job always beats idling it (a packing's cost sum
+            # cannot see the job it dropped); then weighted predicted
+            # cost, then least churn, then the lexicographically
+            # smallest vector: fully deterministic
+            return (unplaced, cost, churn, combo)
+
+        best = min(maximal, key=score)
+        return {j.spec.job_id: s for j, s in zip(jobs, best)}
+
+    def assign_ordinals(self, jobs: Sequence, sizes: Dict[str, int],
+                        *, current: Optional[Dict[str, List[int]]] = None
+                        ) -> Dict[str, List[int]]:
+        """Turn a size packing into concrete pool ordinals.
+
+        Jobs keep as much of their CURRENT interval as possible (a
+        directed resize must stay anchored — the elastic path regrids
+        live state, it does not relocate wholesale): a shrinking job
+        keeps a prefix of its ordinals, a growing job keeps all of them
+        and extends from the free pool, lowest ordinal first.  New jobs
+        take contiguous runs of what remains, in admission order."""
+        current = dict(current or {})
+        taken: set = set()
+        out: Dict[str, List[int]] = {}
+        # pass 1: shrinking / steady jobs keep a prefix
+        for job in jobs:
+            jid = job.spec.job_id
+            size = sizes.get(jid, 0)
+            held = sorted(current.get(jid, []))
+            if held and size and size <= len(held):
+                out[jid] = held[:size]
+                taken.update(out[jid])
+        # reserve growing jobs' held ordinals before anyone extends
+        for job in jobs:
+            jid = job.spec.job_id
+            held = current.get(jid, [])
+            if held and sizes.get(jid, 0) > len(held):
+                taken.update(held)
+        # pass 2: growing jobs keep everything and extend
+        for job in jobs:
+            jid = job.spec.job_id
+            size = sizes.get(jid, 0)
+            held = sorted(current.get(jid, []))
+            if held and size > len(held):
+                grown = list(held)
+                avail = [o for o in range(self.pool_size)
+                         if o not in taken and o not in grown]
+                grown += avail[:size - len(held)]
+                if len(grown) < size:
+                    raise RuntimeError(
+                        f"fleet: cannot grow {jid} to {size} — pool "
+                        f"exhausted (arbiter bug: packing exceeded the "
+                        f"pool)")
+                out[jid] = sorted(grown)
+                taken.update(out[jid])
+        # pass 3: new placements take contiguous runs of the remainder
+        for job in jobs:
+            jid = job.spec.job_id
+            if jid in out:
+                continue
+            size = sizes.get(jid, 0)
+            if not size:
+                out[jid] = []
+                continue
+            avail = [o for o in range(self.pool_size) if o not in taken]
+            if len(avail) < size:
+                raise RuntimeError(
+                    f"fleet: packing for {jid} wants {size} of "
+                    f"{len(avail)} free devices (arbiter bug)")
+            out[jid] = avail[:size]
+            taken.update(out[jid])
+        return out

@@ -1,0 +1,286 @@
+"""The fleet event loop: admit jobs, pack the pool, round-robin quanta,
+and rebalance when demand shifts.
+
+One :class:`FleetCoordinator` owns one device pool (a
+:class:`~flexflow_tpu.machine.MachineModel` over every device) and N
+jobs on disjoint ``slice_of`` slices of it.  The loop is deliberately
+boring — determinism is the feature:
+
+  1. **Admit** — each submitted :class:`~flexflow_tpu.fleet.job.JobSpec`
+     gets its own obs stream at ``obs_dir/<job_id>/`` (concurrent jobs
+     must never interleave one run file; ``apps/report.py`` recurses
+     into the subdirectories) and joins the admission-ordered list.
+  2. **Pack** — the :class:`~flexflow_tpu.fleet.arbiter.Arbiter` prices
+     each job on each candidate slice size and picks the packing
+     (``fleet_placement`` record per packing).
+  3. **Quantum loop** — every running job gets ``quantum`` steps per
+     round (train iterations / decode boundaries), so one process
+     timeshares the pool the way the pool timeshares devices.
+  4. **Rebalance** — after each round the coordinator recomputes every
+     job's demand (train: max; serve: min while calm, max while the
+     queue is at/above its watermark; done jobs: gone).  A changed
+     demand vector triggers a re-pack; if the assignment actually
+     changes, a ``fleet_rebalance`` record is written and the moves
+     execute as DIRECTED resizes — all shrinks before all grows, so the
+     pool never oversubscribes mid-transition.
+
+Drain rides the same dict the elastic runtime uses: SIGTERM sets
+``drain["requested"]``, every job winds down at its next boundary, and
+the driver exits 0 (the scheduler contract — see README "Elastic").
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from flexflow_tpu.fleet.arbiter import Arbiter
+from flexflow_tpu.fleet.job import Job, JobSpec
+
+
+class FleetCoordinator:
+    """Owns the pool, the jobs, and the rebalance economy."""
+
+    def __init__(self, pool, *, obs_dir: str = "", olog=None,
+                 metrics=None, quantum: int = 4, budget_s: float = 30.0,
+                 iters: int = 200, seed: int = 0, pricer=None,
+                 log=print):
+        from flexflow_tpu import obs
+
+        self.pool = pool
+        self.obs_dir = obs_dir
+        self.metrics = metrics
+        self.quantum = max(int(quantum), 1)
+        self.seed = int(seed)
+        self.log = log
+        if olog is not None:
+            self.olog = olog
+        elif obs_dir:
+            self.olog = obs.RunLog(
+                os.path.join(obs_dir, "fleet.jsonl"), surface="fleet",
+                meta={"pool_devices": pool.num_devices})
+        else:
+            self.olog = obs.NULL
+        self.arbiter = Arbiter(pool.num_devices, pricer=pricer,
+                               budget_s=budget_s, iters=iters, seed=seed,
+                               olog=self.olog, log=log)
+        self.jobs: List[Job] = []
+        self.rebalances = 0
+        self._packs = 0
+        self._demand_key = None
+
+    # ------------------------------------------------------------------
+    # admission
+
+    def submit(self, spec: JobSpec) -> Job:
+        """Admit one job: open its private obs stream and queue it
+        pending (placement happens at the next pack)."""
+        from flexflow_tpu import obs
+
+        if any(j.spec.job_id == spec.job_id for j in self.jobs):
+            raise ValueError(f"fleet: duplicate job id {spec.job_id!r}")
+        if self.obs_dir:
+            jdir = os.path.join(self.obs_dir, spec.job_id)
+            jlog = obs.RunLog(
+                os.path.join(jdir, f"{spec.job_id}.jsonl"),
+                surface="serve" if spec.kind == "serve" else "fit",
+                meta={"fleet_job": spec.job_id,
+                      "workload": spec.kind})
+        else:
+            jlog = obs.NULL
+        job = Job(spec, olog=jlog, log=self.log)
+        self.jobs.append(job)
+        self.olog.event("fleet_job", job=spec.job_id,
+                        workload=spec.kind, state="pending",
+                        priority=spec.priority,
+                        min_devices=spec.min_devices,
+                        max_devices=spec.max_devices)
+        return job
+
+    # ------------------------------------------------------------------
+    # packing
+
+    def _placeable(self) -> List[Job]:
+        return [j for j in self.jobs
+                if j.state in ("pending", "running")]
+
+    def _current_sizes(self) -> Dict[str, int]:
+        return {j.spec.job_id: len(j.ordinals) for j in self.jobs
+                if j.ordinals and j.active}
+
+    def _current_ordinals(self) -> Dict[str, List[int]]:
+        return {j.spec.job_id: list(j.ordinals) for j in self.jobs
+                if j.ordinals and j.active}
+
+    def _demands(self) -> tuple:
+        return tuple((j.spec.job_id, j.demand(self.pool.num_devices))
+                     for j in self._placeable())
+
+    def _pack(self) -> Dict[str, int]:
+        jobs = self._placeable()
+        sizes = self.arbiter.pack(jobs, current=self._current_sizes())
+        self._packs += 1
+        self.olog.event(
+            "fleet_placement", pack=self._packs,
+            demands={jid: d for jid, d in self._demands()},
+            sizes=sizes, pool=self.pool.num_devices,
+            native_prices=self.arbiter.native_prices,
+            proxy_prices=self.arbiter.proxy_prices)
+        return sizes
+
+    # ------------------------------------------------------------------
+    # the loop
+
+    def run(self, drain: Optional[Dict] = None) -> Dict:
+        """Place everything submitted so far, then round-robin quanta
+        (rebalancing on demand shifts) until every job is done or
+        failed.  Returns the fleet summary (also the ``fleet_summary``
+        record)."""
+        t0 = time.perf_counter()
+        self._drain = drain
+        self._place_initial(drain)
+        round_ = 0
+        while True:
+            running = [j for j in self.jobs if j.state == "running"]
+            if not running:
+                break
+            round_ += 1
+            for job in running:
+                if job.state != "running":
+                    continue
+                try:
+                    job.step_quantum(self.quantum, drain=drain)
+                except Exception as e:  # noqa: BLE001
+                    self.log(f"fleet: job {job.spec.job_id} failed: {e}")
+            if drain is not None and drain.get("requested"):
+                # jobs wind down at their own boundaries; no rebalances
+                # during a drain — keep stepping until everyone exits
+                continue
+            self._maybe_rebalance()
+        return self._finish(time.perf_counter() - t0)
+
+    def _place_initial(self, drain: Optional[Dict]) -> None:
+        self._demand_key = self._demands()
+        sizes = self._pack()
+        ordinals = self.arbiter.assign_ordinals(
+            self._placeable(), sizes, current=self._current_ordinals())
+        for job in self._placeable():
+            ords = ordinals.get(job.spec.job_id, [])
+            if not ords:
+                self.log(f"fleet: job {job.spec.job_id} does not fit — "
+                         f"left pending")
+                continue
+            job.place(self.pool, ords,
+                      strategy=self.arbiter.priced_strategy(
+                          job, len(ords)),
+                      drain=drain)
+        self._update_metrics()
+
+    def _maybe_rebalance(self) -> None:
+        key = self._demands()
+        if key == self._demand_key:
+            return
+        self._demand_key = key
+        sizes = self._pack()
+        cur = self._current_ordinals()
+        target = self.arbiter.assign_ordinals(
+            self._placeable(), sizes, current=cur)
+        moves = []
+        placements = []
+        for job in self._placeable():
+            jid = job.spec.job_id
+            new = sorted(target.get(jid, []))
+            if job.state == "running" and new and new != job.ordinals:
+                moves.append((job, new))
+            elif job.state == "pending" and new:
+                placements.append((job, new))
+        if not moves and not placements:
+            return
+        if moves:
+            self.rebalances += 1
+            # the rebalance record precedes the elastic_resize records
+            # it causes, in every merged ts-ordering
+            self.olog.event(
+                "fleet_rebalance", rebalance=self.rebalances,
+                moves=[{"job": j.spec.job_id, "from": list(j.ordinals),
+                        "to": new} for j, new in moves],
+                sizes=sizes)
+            self.log(f"fleet: rebalance #{self.rebalances}: "
+                     + ", ".join(f"{j.spec.job_id} "
+                                 f"{len(j.ordinals)}->{len(new)}"
+                                 for j, new in moves))
+            # shrinks release devices before grows claim them
+            moves.sort(key=lambda m: (len(m[1]) - len(m[0].ordinals),
+                                      m[0].spec.job_id))
+            for job, new in moves:
+                try:
+                    job.resize(self.pool, new)
+                except Exception as e:  # noqa: BLE001
+                    self.log(f"fleet: resize of {job.spec.job_id} "
+                             f"failed ({e}); job keeps its current "
+                             f"slice")
+        # queued jobs admitted by the re-pack place after the shrinks
+        # that freed their devices
+        for job, ords in placements:
+            job.place(self.pool, ords,
+                      strategy=self.arbiter.priced_strategy(
+                          job, len(ords)),
+                      drain=self._drain)
+        if self.metrics is not None:
+            self.metrics.update(fleet_rebalances_total=self.rebalances)
+        self._update_metrics()
+
+    def _finish(self, wall_s: float) -> Dict:
+        by_state: Dict[str, int] = {}
+        for j in self.jobs:
+            by_state[j.state] = by_state.get(j.state, 0) + 1
+        jobs_out = []
+        for j in self.jobs:
+            entry = {"job": j.spec.job_id, "kind": j.spec.kind,
+                     "state": j.state, "devices": len(j.ordinals)}
+            if j.spec.kind == "train" and j.result:
+                entry["iters"] = j.result["iters"]
+                entry["final_loss"] = (j.result["loss"][-1]
+                                       if j.result["loss"] else None)
+            if j.spec.kind == "serve" and j.result:
+                entry["completed"] = j.result["completed"]
+                entry["unserved"] = j.result["unserved"]
+            if j.error:
+                entry["error"] = j.error
+            jobs_out.append(entry)
+        summary = {
+            "pool_devices": self.pool.num_devices,
+            "jobs": jobs_out, "by_state": by_state,
+            "rebalances": self.rebalances, "packs": self._packs,
+            "native_prices": self.arbiter.native_prices,
+            "proxy_prices": self.arbiter.proxy_prices,
+            "wall_s": round(wall_s, 3),
+        }
+        self.olog.event("fleet_summary", **summary)
+        self._update_metrics()
+        for j in self.jobs:
+            if j.olog is not self.olog:
+                j.olog.close()
+        return summary
+
+    # ------------------------------------------------------------------
+
+    def _update_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        counts: Dict[str, int] = {}
+        for j in self.jobs:
+            counts[j.state] = counts.get(j.state, 0) + 1
+        self.metrics.update(fleet_jobs=len(self.jobs))
+        for state, n in counts.items():
+            self.metrics.update_labeled("fleet_jobs", {"state": state},
+                                        n)
+        total = 0
+        for j in self.jobs:
+            n = len(j.ordinals) if j.active else 0
+            total += n
+            self.metrics.update_labeled("fleet_job_devices",
+                                        {"job": j.spec.job_id}, n)
+        self.metrics.update(fleet_job_devices=total)
+        self.metrics.write()

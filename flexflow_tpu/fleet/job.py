@@ -1,0 +1,402 @@
+"""Fleet jobs: one spec + lifecycle state machine per tenant, wrapping
+the existing execution machinery.
+
+A :class:`JobSpec` names WHAT runs (workload kind, the ``build(config,
+machine)`` model factory the elastic path already uses, the payload) and
+under WHAT terms (priority, min/max devices, the serve demand
+watermark).  A :class:`Job` is one admitted instance: the coordinator
+moves it through the lifecycle
+
+    pending -> placing -> running -> (draining -> resized -> running)*
+            -> done | failed
+
+where the parenthesized loop is one DIRECTED resize (coordinator-
+imposed, ``utils.elastic.directed_resize`` — never the fault
+classifier): the job drains to its next step boundary, the elastic
+machinery regrids its live state onto the new slice, and it resumes.
+
+Two runner shapes:
+
+  * **train** — a compact version of ``_fit``'s step core: jitted
+    ``make_train_step`` over host numpy batches placed with the CURRENT
+    slice's batch sharding (after a resize the same host ring re-places
+    onto the new mesh — the elastic continuation pattern).  Losses stay
+    on device between syncs; loss CONTINUITY across resizes rides the
+    same ``prior_losses`` mechanism fault recovery uses.
+  * **serve** — a :class:`~flexflow_tpu.serve.engine.ServeEngine`
+    session driven through ``start()`` / ``step_once()`` so the
+    coordinator can interleave decode steps with other jobs' quanta.
+    The engine's own watermark autoscaler is DISABLED (``queue_hi=0``,
+    ``idle_boundaries=0``): the coordinator is the only resizer, and
+    the engine adopts each directed resize via ``adopt_resize``.
+
+Every job logs to its OWN obs stream (``obs_dir/<job_id>/``), so the
+``elastic_resize`` records a directed resize emits land in the job's
+file while the coordinator's ``fleet_*`` records land in the pool's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# lifecycle states and the legal transitions between them
+STATES = ("pending", "placing", "running", "draining", "resized",
+          "done", "failed")
+_TRANSITIONS = {
+    "pending": ("placing", "failed"),
+    "placing": ("running", "failed"),
+    "running": ("draining", "done", "failed"),
+    "draining": ("resized", "done", "failed"),
+    "resized": ("running", "failed"),
+    "done": (),
+    "failed": (),
+}
+
+
+class JobStateError(RuntimeError):
+    """An illegal lifecycle transition (a coordinator bug, not a user
+    error — the state machine is the contract)."""
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """Everything the coordinator needs to admit one tenant.
+
+    ``build(config, machine)`` is the SAME factory shape fit()'s elastic
+    path takes; ``config`` is the job's FFConfig (batch size, iteration
+    count, seed, elastic knobs).  ``payload`` is workload input: a
+    host-batch iterable factory ``() -> iterator`` for train jobs, a
+    request list for serve jobs.  ``min_devices``/``max_devices`` bound
+    the slice the arbiter may assign; ``priority`` weights the job's
+    predicted cost in the packing objective.  ``queue_hi`` is the serve
+    job's DEMAND watermark: queue depth at or above it makes the job
+    bid for ``max_devices`` (0 keeps demand at ``min_devices``)."""
+
+    job_id: str
+    kind: str                      # "train" | "serve"
+    build: object                  # (config, machine) -> model
+    config: object                 # FFConfig
+    payload: object = None
+    priority: float = 1.0
+    min_devices: int = 1
+    max_devices: int = 0           # 0 = no cap beyond the pool
+    queue_hi: int = 0              # serve demand watermark
+    strategy_path: str = ""        # pre-searched strategy artifact
+    search_iters: int = 200        # arbiter pricing proposals per slice
+
+    def __post_init__(self):
+        if self.kind not in ("train", "serve"):
+            raise ValueError(f"job {self.job_id}: kind must be 'train' "
+                             f"or 'serve', got {self.kind!r}")
+        if self.min_devices < 1:
+            raise ValueError(f"job {self.job_id}: min_devices >= 1")
+        if self.max_devices and self.max_devices < self.min_devices:
+            raise ValueError(f"job {self.job_id}: max_devices "
+                             f"{self.max_devices} < min_devices "
+                             f"{self.min_devices}")
+
+
+class Job:
+    """One admitted job: spec + lifecycle + the live runner state."""
+
+    def __init__(self, spec: JobSpec, olog=None, log=print):
+        from flexflow_tpu import obs
+
+        self.spec = spec
+        self.olog = olog if olog is not None else obs.NULL
+        self.log = log
+        self.state = "pending"
+        self.ordinals: List[int] = []   # pool ordinals currently held
+        self.model = None
+        self.engine = None              # serve jobs
+        self.strategy = None            # the strategy the job runs under
+        self.result: Optional[Dict] = None
+        self.error: Optional[str] = None
+        # train runner state
+        self._step = None
+        self._params = self._state = self._opt = None
+        self._batches = None
+        self._sharding = None
+        self._loss_hist: List[float] = []   # host floats, synced
+        self._loss_dev: List = []           # device losses since sync
+        self.iters_done = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def to_state(self, new: str, **detail) -> None:
+        """One legal transition, recorded as a ``fleet_job`` event on the
+        JOB's stream (the coordinator mirrors it on the pool stream)."""
+        if new not in STATES:
+            raise JobStateError(f"unknown state {new!r}")
+        if new not in _TRANSITIONS[self.state]:
+            raise JobStateError(
+                f"job {self.spec.job_id}: illegal transition "
+                f"{self.state} -> {new}")
+        old, self.state = self.state, new
+        # "workload", not "kind" — the obs record's own kind field is
+        # "fleet_job" and must not be shadowed
+        self.olog.event("fleet_job", job=self.spec.job_id,
+                        workload=self.spec.kind, state=new,
+                        from_state=old, devices=len(self.ordinals),
+                        **detail)
+
+    @property
+    def active(self) -> bool:
+        return self.state in ("placing", "running", "draining",
+                              "resized")
+
+    def fail(self, err: str) -> None:
+        self.error = err
+        if self.state not in ("done", "failed"):
+            self.to_state("failed", error=err)
+
+    # ------------------------------------------------------------------
+    # demand: what slice size the job currently bids for
+
+    def demand(self, pool_size: int) -> int:
+        """The size this job currently WANTS (the arbiter caps candidate
+        slices at it): train jobs always bid their max (more devices is
+        a faster step); serve jobs yield down to ``min_devices`` while
+        the queue is calm and bid ``max_devices`` once depth crosses the
+        ``queue_hi`` watermark — that demand shift is what triggers the
+        coordinator's rebalances."""
+        cap = self.spec.max_devices or pool_size
+        if self.spec.kind == "train":
+            return min(cap, pool_size)
+        if (self.spec.queue_hi > 0 and self.engine is not None
+                and self.engine.queue_depth() >= self.spec.queue_hi):
+            return min(cap, pool_size)
+        return self.spec.min_devices
+
+    def feasible_sizes(self, pool_size: int) -> List[int]:
+        """Slice sizes this job can run on, ascending: within
+        [min_devices, max_devices] and dividing the job's batch (the
+        compiled rectangle must shard evenly over the slice)."""
+        cap = min(self.spec.max_devices or pool_size, pool_size)
+        batch = int(getattr(self.spec.config, "batch_size", 0) or 0)
+        out = []
+        for s in range(self.spec.min_devices, cap + 1):
+            if batch and batch % s:
+                continue
+            out.append(s)
+        return out
+
+    def candidate_sizes(self, pool_size: int) -> List[int]:
+        """The sizes the arbiter may actually assign this job right now:
+        feasible sizes capped at the current demand — and for a
+        BACKLOGGED serve job the bid is binding (only the largest
+        feasible size at the bid), because handing a backlogged server
+        one spare device is not relief, it is churn.  Train jobs stay
+        flexible across their whole feasible range so the packing can
+        trade them down when a serve bid arrives."""
+        sizes = self.feasible_sizes(pool_size)
+        want = self.demand(pool_size)
+        capped = [s for s in sizes if s <= want] or sizes[:1]
+        if self.spec.kind == "serve" and want > self.spec.min_devices:
+            capped = capped[-1:]
+        return capped
+
+    # ------------------------------------------------------------------
+    # placement
+
+    def place(self, pool, ordinals: Sequence[int], strategy=None,
+              drain: Optional[Dict] = None) -> None:
+        """Build the job's model on its pool slice and start the runner.
+        ``strategy`` is the arbiter's priced plan for this slice size
+        (None = pure DP)."""
+        import copy
+
+        from flexflow_tpu.strategy import Strategy
+
+        self.to_state("placing", ordinals=sorted(int(i) for i in ordinals))
+        self.ordinals = sorted(int(i) for i in ordinals)
+        machine = pool.slice_of(self.ordinals)
+        cfg = copy.copy(self.spec.config)
+        # the elastic shrink path enforces cfg.min_devices — align it
+        # with the spec so a directed shrink below the floor is refused
+        cfg.min_devices = self.spec.min_devices
+        cfg.strategies = strategy if strategy is not None else Strategy()
+        self.strategy = cfg.strategies
+        self.model = self.spec.build(cfg, machine)
+        if self.spec.kind == "train":
+            self._start_train()
+        else:
+            self._start_serve(drain)
+        self.to_state("running")
+
+    def _start_train(self) -> None:
+        from flexflow_tpu.data.synthetic import _batch_sharding
+
+        model = self.model
+        self._params, self._state = model.init(model.config.seed)
+        self._opt = model.init_opt_state(self._params)
+        self._step = model.make_train_step()
+        self._sharding = _batch_sharding(model.machine)
+        self._batches = self.spec.payload()
+        self.iters_done = 0
+
+    def _start_serve(self, drain: Optional[Dict]) -> None:
+        from flexflow_tpu.serve.engine import ServeEngine
+
+        # the coordinator is the only resizer: watermarks off
+        self.engine = ServeEngine(self.model, None, olog=self.olog,
+                                  log=self.log, queue_hi=0,
+                                  idle_boundaries=0)
+        self.engine.start(list(self.spec.payload), drain=drain)
+
+    # ------------------------------------------------------------------
+    # stepping
+
+    def step_quantum(self, n: int, drain: Optional[Dict] = None) -> bool:
+        """Up to ``n`` steps (train iterations / decode boundaries).
+        Returns True while the job has work left; on exhaustion the job
+        transitions to ``done`` with its result attached."""
+        if self.state != "running":
+            return self.active
+        try:
+            if self.spec.kind == "train":
+                return self._train_quantum(n, drain)
+            return self._serve_quantum(n)
+        except Exception as e:  # noqa: BLE001 — one job must not kill the fleet
+            self.fail(f"{type(e).__name__}: {e}")
+            raise
+
+    def _train_quantum(self, n: int, drain: Optional[Dict]) -> bool:
+        import jax
+
+        total = int(self.model.config.num_iterations)
+        for _ in range(n):
+            if self.iters_done >= total:
+                break
+            if drain is not None and drain.get("requested"):
+                break
+            batch = next(self._batches)
+            placed = tuple(jax.device_put(np.asarray(x), self._sharding)
+                           for x in batch)
+            self._params, self._state, self._opt, loss = self._step(
+                self._params, self._state, self._opt, *placed)
+            self._loss_dev.append(loss)
+            self.iters_done += 1
+        drained = bool(drain is not None and drain.get("requested"))
+        if self.iters_done >= total or drained:
+            self._sync_losses()
+            self.result = {
+                "loss": list(self._loss_hist),
+                "iters": self.iters_done,
+                "devices": self.model.machine.num_devices,
+                "drained": drained and self.iters_done < total,
+            }
+            self.to_state("done", iters=self.iters_done,
+                          drained=self.result["drained"])
+            return False
+        return True
+
+    def _serve_quantum(self, n: int) -> bool:
+        eng = self.engine
+        for _ in range(n):
+            if not eng.step_once():
+                break
+        if not eng.pending():
+            self.result = eng.finish()
+            self.to_state("done",
+                          completed=self.result["completed"],
+                          unserved=self.result["unserved"])
+            return False
+        return True
+
+    def _sync_losses(self) -> None:
+        import jax
+
+        if self._loss_dev:
+            self._loss_hist.extend(
+                float(v) for v in jax.device_get(self._loss_dev))
+            self._loss_dev = []
+
+    # ------------------------------------------------------------------
+    # directed resize (the coordinator's preemption economy)
+
+    def resize(self, pool, new_ordinals: Sequence[int]) -> List[Dict]:
+        """Move this RUNNING job to ``new_ordinals`` (pool ordinals) via
+        the elastic machinery's directed entry point.  A nested change
+        is one shrink or one grow; a sideways move (partial overlap)
+        decomposes into shrink-to-intersection + grow — each leg emits
+        one ``elastic_resize`` record on the job's stream.  Walks the
+        lifecycle running -> draining -> resized -> running."""
+        new = sorted(int(i) for i in new_ordinals)
+        old = list(self.ordinals)
+        if new == old:
+            return []
+        if not set(new) & set(old):
+            raise JobStateError(
+                f"job {self.spec.job_id}: target slice {new} shares no "
+                f"device with the current {old} — a fleet repack must "
+                f"keep every job anchored (nested or overlapping moves "
+                f"only)")
+        self.to_state("draining", target=new)
+        legs = []
+        inter = sorted(set(new) & set(old))
+        if inter != old:          # release what the target drops
+            legs.append(self._resize_leg(pool, inter, old))
+        if new != inter:          # adopt what the target adds
+            legs.append(self._resize_leg(pool, new, inter))
+        self.ordinals = new
+        self.to_state("resized", ordinals=new,
+                      directions=[r["direction"] for r in legs])
+        self.to_state("running")
+        return legs
+
+    def _resize_leg(self, pool, target: List[int],
+                    cur: List[int]) -> Dict:
+        """One pure shrink or pure grow leg, through
+        ``utils.elastic.directed_resize``."""
+        from flexflow_tpu.utils.elastic import directed_resize
+
+        if set(target) < set(cur):
+            keep = [cur.index(o) for o in target]
+            kw = {"keep": keep}
+        else:
+            added = [o for o in target if o not in cur]
+            kw = {"add": pool.devices_at(added),
+                  "pre_strategy": self.strategy}
+        if self.spec.kind == "train":
+            self._sync_losses()
+            step = self.iters_done
+            new_model, carry, prior = directed_resize(
+                self.model, step=step, params=self._params,
+                state=self._state, opt_state=self._opt,
+                losses=(), loss_base=step, rebuild=self.spec.build,
+                olog=self.olog, log=self.log, objective="makespan",
+                **kw)
+            self.model = new_model
+            self._params = carry["params"]
+            self._state = carry["state"]
+            self._opt = carry["opt_state"] \
+                or new_model.init_opt_state(carry["params"])
+            self._step = new_model.make_train_step()
+            from flexflow_tpu.data.synthetic import _batch_sharding
+
+            self._sharding = _batch_sharding(new_model.machine)
+        else:
+            eng = self.engine
+            step = eng._sess["steps"] if eng._sess else 0
+            new_model, carry, _ = directed_resize(
+                self.model, step=step, params=eng.params,
+                state=eng.state, opt_state=None, losses=(),
+                rebuild=self.spec.build, olog=self.olog, log=self.log,
+                objective="latency", **kw)
+            self.model = new_model
+            eng.adopt_resize(new_model, carry)
+        self.strategy = getattr(self.model.config, "strategies", None)
+        return {"direction": "shrink" if "keep" in kw else "grow",
+                "devices": self.model.machine.num_devices}
+
+    # ------------------------------------------------------------------
+
+    def losses(self) -> List[float]:
+        """Synced host loss history (train jobs)."""
+        self._sync_losses()
+        return list(self._loss_hist)

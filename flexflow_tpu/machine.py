@@ -165,6 +165,33 @@ class MachineModel:
                 f"{self.num_devices}-device machine")
         return MachineModel(devices=[self.devices[i] for i in idx])
 
+    def slice_of(self, ordinals: Sequence[int]) -> "MachineModel":
+        """A fresh MachineModel over an arbitrary ordinal subset of THIS
+        machine — the fleet coordinator's slicing primitive
+        (fleet/coordinator.py): N concurrent jobs each run on a disjoint
+        ``pool.slice_of(...)`` of one shared pool machine.  Identical
+        validation and semantics to :meth:`shrink` (to which it
+        delegates), but named for intent: nothing died, the pool is just
+        being carved."""
+        return self.shrink(ordinals)
+
+    def devices_at(self, ordinals: Sequence[int]) -> list:
+        """The device OBJECTS at ``ordinals`` (in the given order) — what
+        a directed grow hands to :meth:`grow` / ``directed_resize(add=)``
+        when the coordinator grants a job devices it does not currently
+        hold (ordinals are into THIS pool machine, which still holds
+        every object; the job's shrunk view does not)."""
+        n = self.num_devices
+        out = []
+        for i in ordinals:
+            i = int(i)
+            if not 0 <= i < n:
+                raise ValueError(
+                    f"ordinal {i} out of range for this {n}-device "
+                    f"machine")
+            out.append(self.devices[i])
+        return out
+
     def grow(self, returned: Sequence) -> "MachineModel":
         """The inverse resize primitive: a fresh MachineModel over THIS
         machine's devices plus ``returned`` — previously-dead device

@@ -76,10 +76,19 @@ _HELP = {
                      "arrival to completion)",
     "latency_p99_s": "serving request latency p99 (virtual seconds)",
     "requests_total": "serving requests completed this run",
+    "fleet_jobs": "fleet jobs by lifecycle state, exported as "
+                  "ff_fleet_jobs{state=...}; the plain series is the "
+                  "total job count",
+    "fleet_job_devices": "devices currently assigned to each fleet "
+                         "job, exported as ff_fleet_job_devices"
+                         "{job=...}; the plain series is the pool's "
+                         "assigned total",
+    "fleet_rebalances_total": "fleet packing rebalances this run",
 }
+_COUNTER_EXTRA = {"fleet_rebalances_total"}
 _COUNTERS = {"steps_total", "rollbacks_total", "faults_total",
              "prefetch_stall_seconds_total", "elastic_events",
-             "requests_total"}
+             "requests_total"} | _COUNTER_EXTRA
 
 
 def _finite(v) -> Optional[float]:

@@ -547,6 +547,54 @@ def _trace_section(events: List[Dict]) -> List[str]:
     return lines
 
 
+def _fleet_section(events: List[Dict]) -> List[str]:
+    """The coordinator's view: per-job lifecycle trails, each arbiter
+    packing, each executed rebalance, and the final fleet summary.
+    Renders merged multi-job streams (coordinator + per-job subdirs)
+    as readily as the coordinator's stream alone."""
+    jobs = [e for e in events if e.get("kind") == "fleet_job"]
+    placements = [e for e in events
+                  if e.get("kind") == "fleet_placement"]
+    rebalances = [e for e in events
+                  if e.get("kind") == "fleet_rebalance"]
+    summaries = [e for e in events if e.get("kind") == "fleet_summary"]
+    if not (jobs or placements or rebalances or summaries):
+        return []
+    lines = ["== fleet =="]
+    trail: Dict[str, List[str]] = {}
+    workload: Dict[str, str] = {}
+    for e in jobs:
+        jid = str(e.get("job"))
+        if e.get("workload"):
+            workload[jid] = str(e["workload"])
+        states = trail.setdefault(jid, [])
+        st = str(e.get("state"))
+        if not states or states[-1] != st:
+            states.append(st)
+    for jid in sorted(trail):
+        wl = f" ({workload[jid]})" if jid in workload else ""
+        lines.append(f"  job {jid}{wl}: " + " -> ".join(trail[jid]))
+    for p in placements:
+        lines.append(f"  placement #{p.get('pack', '?')}: "
+                     f"sizes {p.get('sizes')} (demands "
+                     f"{p.get('demands')}, pool {p.get('pool')})")
+    for r in rebalances:
+        moves = ", ".join(
+            f"{m.get('job')} {len(m.get('from') or [])}->"
+            f"{len(m.get('to') or [])}" for m in r.get("moves") or [])
+        lines.append(f"  rebalance #{r.get('rebalance', '?')}: {moves}")
+    if summaries:
+        s = summaries[-1]
+        lines.append(
+            f"  summary: {len(s.get('jobs') or [])} job(s) "
+            f"{s.get('by_state')}, {s.get('rebalances', 0)} "
+            f"rebalance(s), {s.get('packs', 0)} packing(s), "
+            f"{s.get('native_prices', 0)} native + "
+            f"{s.get('proxy_prices', 0)} proxy price(s), pool "
+            f"{s.get('pool_devices')}")
+    return lines
+
+
 def _misc_section(events: List[Dict]) -> List[str]:
     known = {"run_start", "compile", "step", "summary", "checkpoint_save",
              "checkpoint_restore", "sim_drift", "sim_drift_unavailable",
@@ -562,7 +610,9 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "device_return", "step_hang", "preempt_drain",
              "ckpt_async", "lint",
              "serve_request", "serve_batch", "serve_resize",
-             "serve_summary"}
+             "serve_summary",
+             "fleet_job", "fleet_placement", "fleet_rebalance",
+             "fleet_summary"}
     lines = []
     for e in events:
         kind = e.get("kind")
@@ -589,7 +639,8 @@ def render(events: Iterable[Dict]) -> str:
         return "(empty run log)"
     sections = [_header(events), _fit_section(events),
                 _fault_section(events), _elastic_section(events),
-                _serve_section(events), _search_section(events),
+                _serve_section(events), _fleet_section(events),
+                _search_section(events),
                 _audit_bench_section(events), _lint_section(events),
                 _trace_section(events), _misc_section(events)]
     return "\n".join("\n".join(s) for s in sections if s)
@@ -851,6 +902,43 @@ def summarize(events: Iterable[Dict]) -> Dict:
                               "resizes", "virtual_s", "drained",
                               "devices")}
         out["serve"] = sv
+    fleet_kinds = ("fleet_job", "fleet_placement", "fleet_rebalance",
+                   "fleet_summary")
+    if any(kinds.get(k) for k in fleet_kinds):
+        fl: Dict = {"counts": {k: kinds[k] for k in fleet_kinds
+                               if kinds.get(k)},
+                    "rebalances": kinds.get("fleet_rebalance", 0)}
+        trail: Dict[str, List[str]] = {}
+        for e in events:
+            if e.get("kind") != "fleet_job":
+                continue
+            states = trail.setdefault(str(e.get("job")), [])
+            st = str(e.get("state"))
+            if not states or states[-1] != st:
+                states.append(st)
+        if trail:
+            fl["jobs"] = trail
+        packs = [e for e in events
+                 if e.get("kind") == "fleet_placement"]
+        if packs:
+            fl["packs"] = [{"pack": p.get("pack"),
+                            "sizes": p.get("sizes"),
+                            "demands": p.get("demands")} for p in packs]
+        moves = [e for e in events if e.get("kind") == "fleet_rebalance"]
+        if moves:
+            fl["moves"] = [
+                [{"job": m.get("job"),
+                  "from_devices": len(m.get("from") or []),
+                  "to_devices": len(m.get("to") or [])}
+                 for m in r.get("moves") or []] for r in moves]
+        fsums = [e for e in events if e.get("kind") == "fleet_summary"]
+        if fsums:
+            s = fsums[-1]
+            fl["summary"] = {k: s.get(k) for k in
+                             ("pool_devices", "by_state", "rebalances",
+                              "packs", "native_prices", "proxy_prices",
+                              "wall_s")}
+        out["fleet"] = fl
     fault_kinds = ("fault", "rollback", "recovery", "data_fault",
                    "ckpt_fallback", "thread_leak")
     if any(kinds.get(k) for k in fault_kinds):

@@ -96,6 +96,7 @@ class ServeEngine:
         self.step_time_s = float(step_time_s) if step_time_s else \
             self._predicted_step_time()
         self.resizes: List[Dict] = []
+        self._sess: Optional[Dict] = None   # open start()/finish() session
         self._parked: List = []       # device OBJECTS out of service
         self.params = None
         self.state = None
@@ -162,88 +163,136 @@ class ServeEngine:
     def run(self, requests: Sequence[Request],
             drain: Optional[Dict] = None) -> Dict:
         """Serve ``requests`` to completion (or drain) and return the
-        summary dict (also emitted as the ``serve_summary`` record)."""
-        t_wall0 = time.perf_counter()
-        queue = RequestQueue(requests)
-        batcher = ContinuousBatcher(self.max_batch, self.max_len)
-        vnow = 0.0
-        steps = 0
-        idle_streak = 0
-        draining = False
-        completed: List[Request] = []
-        unserved: List[Request] = []
-        extra = self._zero_extra_inputs()
+        summary dict (also emitted as the ``serve_summary`` record).
 
-        while queue.pending() or batcher.num_active():
-            if drain is not None and drain.get("requested") \
-                    and not draining:
-                draining = True
-                unserved = queue.drain()
-                self.log(f"serve: drain requested — finishing "
-                         f"{batcher.num_active()} in-flight request(s), "
-                         f"{len(unserved)} queued request(s) unserved")
-            admitted = [] if draining else batcher.admit(queue, vnow)
+        Implemented as :meth:`start` + :meth:`step_once` to exhaustion +
+        :meth:`finish` — the fleet coordinator drives the same three
+        methods directly to interleave several jobs' decode steps in
+        quanta on one process."""
+        self.start(requests, drain=drain)
+        while self.step_once():
+            pass
+        return self.finish()
+
+    def start(self, requests: Sequence[Request],
+              drain: Optional[Dict] = None) -> None:
+        """Open a decode session over ``requests``; loop state lives on
+        the engine until :meth:`finish`."""
+        self._sess = {
+            "t_wall0": time.perf_counter(),
+            "queue": RequestQueue(requests),
+            "batcher": ContinuousBatcher(self.max_batch, self.max_len),
+            "vnow": 0.0, "steps": 0, "idle_streak": 0,
+            "draining": False, "completed": [], "unserved": [],
+            "extra": self._zero_extra_inputs(), "drain": drain,
+            "done": False,
+        }
+
+    def pending(self) -> bool:
+        """Work remains in the open session (queued or in-flight)."""
+        s = getattr(self, "_sess", None)
+        if s is None or s["done"]:
+            return False
+        return bool(s["queue"].pending() or s["batcher"].num_active())
+
+    def queue_depth(self) -> int:
+        """Arrived-but-unadmitted depth at the session's virtual now —
+        the coordinator's load signal."""
+        s = getattr(self, "_sess", None)
+        return int(s["queue"].depth(s["vnow"])) if s is not None else 0
+
+    def step_once(self) -> bool:
+        """One scheduling boundary of the open session: drain check,
+        admission, watermark triggers, then at most one decode step.
+        Returns True while work remains, False once the session is
+        exhausted (call :meth:`finish` then)."""
+        s = self._sess
+        if s["done"]:
+            return False
+        queue, batcher = s["queue"], s["batcher"]
+        if not (queue.pending() or batcher.num_active()):
+            s["done"] = True
+            return False
+        drain = s["drain"]
+        if drain is not None and drain.get("requested") \
+                and not s["draining"]:
+            s["draining"] = True
+            s["unserved"] = queue.drain()
+            self.log(f"serve: drain requested — finishing "
+                     f"{batcher.num_active()} in-flight request(s), "
+                     f"{len(s['unserved'])} queued request(s) unserved")
+        vnow = s["vnow"]
+        admitted = [] if s["draining"] else batcher.admit(queue, vnow)
+        depth = queue.depth(vnow)
+        if (self.queue_hi > 0 and depth >= self.queue_hi
+                and self._parked and not s["draining"]):
+            self._resize("grow", s["steps"], vnow, depth,
+                         s["idle_streak"])
+            # the regrown mesh serves the backlog from the next step
+            admitted += batcher.admit(queue, vnow)
             depth = queue.depth(vnow)
-            if (self.queue_hi > 0 and depth >= self.queue_hi
-                    and self._parked and not draining):
-                self._resize("grow", steps, vnow, depth, idle_streak)
-                # the regrown mesh serves the backlog from the next step
-                admitted += batcher.admit(queue, vnow)
-                depth = queue.depth(vnow)
-            if batcher.num_active() == 0:
-                nxt = queue.next_arrival()
-                if nxt is None:
-                    break  # drained queue, no in-flight work
-                # idle boundary: no work until the next arrival
-                idle_streak += 1
-                if (self.idle_boundaries > 0
-                        and idle_streak >= self.idle_boundaries
-                        and not self._parked and not draining):
-                    self._resize("shrink", steps, vnow, depth,
-                                 idle_streak)
-                if (self.idle_boundaries <= 0
-                        or idle_streak > self.idle_boundaries):
-                    vnow = max(vnow, nxt)  # nothing left to trigger
-                else:
-                    vnow = min(vnow + self.step_time_s, nxt)
-                continue
-            idle_streak = 0
+        if batcher.num_active() == 0:
+            nxt = queue.next_arrival()
+            if nxt is None:
+                s["done"] = True
+                return False  # drained queue, no in-flight work
+            # idle boundary: no work until the next arrival
+            s["idle_streak"] += 1
+            if (self.idle_boundaries > 0
+                    and s["idle_streak"] >= self.idle_boundaries
+                    and not self._parked and not s["draining"]):
+                self._resize("shrink", s["steps"], vnow, depth,
+                             s["idle_streak"])
+            if (self.idle_boundaries <= 0
+                    or s["idle_streak"] > self.idle_boundaries):
+                s["vnow"] = max(vnow, nxt)  # nothing left to trigger
+            else:
+                s["vnow"] = min(vnow + self.step_time_s, nxt)
+            return True
+        s["idle_streak"] = 0
 
-            # one decode step over the full rectangle
-            active = batcher.active()
-            pre_lengths = {i: s.length for i, s in active}
-            tokens = batcher.token_matrix(self.pad_id)
-            t0 = time.perf_counter()
-            outs = self._predict(self.params, self.state, tokens, *extra)
-            logprobs = np.asarray(outs[0])
-            step_wall = time.perf_counter() - t0
-            self._fill_kv(outs[1:], active, pre_lengths)
-            for slot_idx, slot in active:
-                nxt_tok = int(np.argmax(logprobs[slot_idx,
-                                                 slot.length - 1]))
-                slot.req.wall_s += step_wall
-                batcher.record_token(slot_idx, nxt_tok)
-            vnow += self.step_time_s
-            steps += 1
-            for slot_idx, req in batcher.reclaim(vnow):
-                if self.kv_cache is not None:
-                    self.kv_cache.reclaim(slot_idx)
-                self._kv_filled[slot_idx] = 0
-                completed.append(req)
-                self.olog.event(
-                    "serve_request", rid=req.rid, arrival_v=req.arrival_v,
-                    admit_v=req.admit_v, done_v=req.done_v,
-                    latency_s=req.latency_s, prompt_len=len(req.tokens),
-                    new_tokens=len(req.reply or ()), wall_s=req.wall_s)
-            self.olog.event("serve_batch", step=steps, vnow=vnow,
-                            active=len(active), admitted=len(admitted),
-                            queue_depth=depth,
-                            devices=self.model.machine.num_devices)
-            self._update_gauges(completed, depth, vnow)
+        # one decode step over the full rectangle
+        active = batcher.active()
+        pre_lengths = {i: sl.length for i, sl in active}
+        tokens = batcher.token_matrix(self.pad_id)
+        t0 = time.perf_counter()
+        outs = self._predict(self.params, self.state, tokens,
+                             *s["extra"])
+        logprobs = np.asarray(outs[0])
+        step_wall = time.perf_counter() - t0
+        self._fill_kv(outs[1:], active, pre_lengths)
+        for slot_idx, slot in active:
+            nxt_tok = int(np.argmax(logprobs[slot_idx,
+                                             slot.length - 1]))
+            slot.req.wall_s += step_wall
+            batcher.record_token(slot_idx, nxt_tok)
+        s["vnow"] = vnow = vnow + self.step_time_s
+        s["steps"] += 1
+        for slot_idx, req in batcher.reclaim(vnow):
+            if self.kv_cache is not None:
+                self.kv_cache.reclaim(slot_idx)
+            self._kv_filled[slot_idx] = 0
+            s["completed"].append(req)
+            self.olog.event(
+                "serve_request", rid=req.rid, arrival_v=req.arrival_v,
+                admit_v=req.admit_v, done_v=req.done_v,
+                latency_s=req.latency_s, prompt_len=len(req.tokens),
+                new_tokens=len(req.reply or ()), wall_s=req.wall_s)
+        self.olog.event("serve_batch", step=s["steps"], vnow=vnow,
+                        active=len(active), admitted=len(admitted),
+                        queue_depth=depth,
+                        devices=self.model.machine.num_devices)
+        self._update_gauges(s["completed"], depth, vnow)
+        return True
 
-        return self._summarize(completed, unserved, vnow, steps,
-                               time.perf_counter() - t_wall0,
-                               drained=draining)
+    def finish(self) -> Dict:
+        """Close the session: emit ``serve_summary`` and return it."""
+        s = self._sess
+        self._sess = None
+        return self._summarize(s["completed"], s["unserved"], s["vnow"],
+                               s["steps"],
+                               time.perf_counter() - s["t_wall0"],
+                               drained=s["draining"])
 
     def _fill_kv(self, attn_ins, active, pre_lengths) -> None:
         """Project this step's NEW positions into the KV cache from the
@@ -401,6 +450,24 @@ class ServeEngine:
                  f"{step} (queue depth {depth}, idle streak "
                  f"{idle_streak}, re-search {research_s:.2f}s "
                  f"[{research['mode']}])")
+
+    def adopt_resize(self, new_model, carry: Dict,
+                     parked: Sequence = ()) -> None:
+        """Adopt a COORDINATOR-directed resize performed outside the
+        engine (utils/elastic.directed_resize under the latency
+        objective): swap in the rebuilt model and its placed state,
+        recompile the predict step and reset the KV layout.  Safe
+        mid-session — the batch rectangle is unchanged and the next
+        :meth:`step_once` refills in-flight slots' KV prefixes from the
+        full-rectangle forward exactly like the autoscaler's own
+        ``_resize`` recompile does.  The engine's watermark autoscaler
+        and the coordinator must not both steer one engine: fleet jobs
+        run with ``queue_hi=0`` / ``idle_boundaries=0``."""
+        self.model = new_model
+        self._parked = list(parked)
+        self.params = carry["params"]
+        self.state = carry["state"]
+        self._compile(carry={"params": self.params, "state": self.state})
 
     # ------------------------------------------------------------------
     # reporting

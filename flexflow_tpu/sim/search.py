@@ -1240,3 +1240,47 @@ class StrategySearch:
         self.obs.event("search_breakdown",
                        ops=self.cost_breakdown(assignment),
                        opt_stream_s=self._opt_stream_s)
+
+
+def price_on_slice(rebuild, config, num_devices, *,
+                   objective: str = "makespan", iters: int = 300,
+                   seed: int = 0, warm_strategy=None,
+                   budget_s: Optional[float] = None, topology=None,
+                   obs=None):
+    """Price one JOB on one candidate slice size — the fleet arbiter's
+    pricing seam (fleet/arbiter.py): the same native simulator that
+    prices an op on a device slice prices the whole job's best-found
+    strategy on a virtual ``num_devices``-device machine.
+
+    ``rebuild(config, machine)`` is the job's model factory (the same
+    one fit()'s elastic path uses); the graph is built on
+    ``MachineModel.virtual`` so nothing touches real devices.  The
+    search is warm-started from ``warm_strategy`` (the job's running
+    strategy — entries that survive on the candidate slice keep their
+    config) and capped by ``iters`` AND ``budget_s``: under a fixed
+    seed with a generous budget the iteration bound binds, so the
+    arbiter's packing is reproducible.
+
+    Returns ``(predicted_s, strategy, info)`` where ``predicted_s`` is
+    the objective value (step makespan for ``"makespan"``, forward-step
+    latency for ``"latency"``).  Raises when the native simulator is
+    unavailable — the arbiter degrades to its deterministic DP proxy."""
+    import copy
+
+    from flexflow_tpu import obs as obsmod
+    from flexflow_tpu.utils.elastic import warm_assignment
+
+    shell_cfg = copy.copy(config)
+    shell_cfg.strategies = Strategy()
+    machine = MachineModel.virtual(int(num_devices), topology)
+    shell = rebuild(shell_cfg, machine)
+    ss = StrategySearch(shell, machine=machine,
+                        obs=obs if obs is not None else obsmod.NULL,
+                        objective=objective)
+    start = None
+    if warm_strategy is not None and len(warm_strategy):
+        start = warm_assignment(ss, warm_strategy)
+    strategy, info = ss.search(iters=int(iters), seed=int(seed),
+                               chunks=4, chains=1, delta=True,
+                               start=start, budget_s=budget_s)
+    return float(info["best_time"]), strategy, info

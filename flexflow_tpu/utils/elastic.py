@@ -369,7 +369,8 @@ def research_strategy(config, rebuild, new_machine, old_strategy,
 
 
 def recover(model, sig: DeviceLossDetected, rebuild, olog=None,
-            log=print):
+            log=print, cause: str = "fault",
+            objective: str = "makespan"):
     """Full surviving-mesh recovery for one detected permanent loss.
 
     Returns ``(new_model, carry, prior_losses)``:
@@ -382,9 +383,12 @@ def recover(model, sig: DeviceLossDetected, rebuild, olog=None,
         valid after recovery (trimmed when a checkpoint fallback rewinds
         past them), for the caller's loss-continuity bookkeeping.
 
-    Emits exactly ONE ``elastic_resize`` record per call (plus the
-    ``device_loss`` detection record and, on the fallback path, an
-    ``elastic_fallback`` record)."""
+    Emits exactly ONE ``elastic_resize`` record per call (plus, when
+    ``cause`` is ``"fault"``, the ``device_loss`` detection record and,
+    on the fallback path, an ``elastic_fallback`` record).  ``cause``
+    is ``"fault"`` on the classification path and ``"directed"`` when a
+    coordinator imposes the target set (:func:`directed_resize`) — no
+    hardware failed, so no fault record is written."""
     import copy
 
     import jax
@@ -399,10 +403,17 @@ def recover(model, sig: DeviceLossDetected, rebuild, olog=None,
     dead = set(sig.dead)
     live = [i for i in range(n_old) if i not in dead]
     min_devices = max(int(getattr(cfg, "min_devices", 1) or 1), 1)
-    olog.event("device_loss", step=sig.step, classification="permanent",
-               dead=sorted(dead), live=len(live), devices=n_old)
-    log(f"elastic: permanent device loss at iteration {sig.step} — "
-        f"ordinals {sorted(dead)} dead, {len(live)}/{n_old} surviving")
+    if cause == "fault":
+        olog.event("device_loss", step=sig.step,
+                   classification="permanent", dead=sorted(dead),
+                   live=len(live), devices=n_old)
+        log(f"elastic: permanent device loss at iteration {sig.step} — "
+            f"ordinals {sorted(dead)} dead, {len(live)}/{n_old} "
+            f"surviving")
+    else:
+        log(f"elastic: directed shrink at iteration {sig.step} — "
+            f"releasing ordinals {sorted(dead)}, keeping "
+            f"{len(live)}/{n_old}")
     if len(live) < min_devices:
         olog.event("elastic_refused", step=sig.step, live=len(live),
                    min_devices=min_devices, dead=sorted(dead))
@@ -424,7 +435,8 @@ def recover(model, sig: DeviceLossDetected, rebuild, olog=None,
     t_search = time.perf_counter()
     strategy, research = research_strategy(
         cfg, rebuild, new_machine,
-        getattr(cfg, "strategies", None), olog=olog, log=log)
+        getattr(cfg, "strategies", None), olog=olog, log=log,
+        objective=objective)
     research_s = time.perf_counter() - t_search
 
     final_cfg = copy.copy(cfg)
@@ -491,7 +503,7 @@ def recover(model, sig: DeviceLossDetected, rebuild, olog=None,
 
     rec = {
         "step": sig.step, "direction": "shrink", "from_devices": n_old,
-        "to_devices": len(live), "dead": sorted(dead),
+        "to_devices": len(live), "dead": sorted(dead), "cause": cause,
         "research_s": research_s, "research": research,
         "migration": "in_memory" if migrated else "checkpoint",
         "resume_step": resume_step, "steps_lost": steps_lost,
@@ -594,7 +606,8 @@ def probe_regrow(ctx: Dict, inj=None, olog=None, probe=None,
 
 
 def recover_grow(model, sig: DeviceReturnDetected, ctx: Dict, rebuild,
-                 olog=None, log=print):
+                 olog=None, log=print, cause: str = "fault",
+                 objective: str = "makespan"):
     """Full re-expansion for one detected device return — the inverse of
     :func:`recover`.  Grows the machine back (``MachineModel.grow``),
     re-searches warm-started from the cached PRE-SHRINK strategy (the
@@ -606,7 +619,9 @@ def recover_grow(model, sig: DeviceReturnDetected, ctx: Dict, rebuild,
 
     Returns ``(new_model, carry, prior_losses)`` like :func:`recover`,
     and emits exactly ONE ``elastic_resize`` record with ``direction:
-    "grow"`` (plus the ``device_return`` detection record)."""
+    "grow"`` (plus, when ``cause`` is ``"fault"``, the ``device_return``
+    detection record — a coordinator-directed grow saw no device come
+    back from a failure, so it writes none)."""
     import copy
 
     import jax
@@ -621,12 +636,17 @@ def recover_grow(model, sig: DeviceReturnDetected, ctx: Dict, rebuild,
     ordinals = sorted(_device_ordinal(d) for d in returned_devs)
     new_machine = model.machine.grow(returned_devs)
     n_new = new_machine.num_devices
-    olog.event("device_return", step=sig.step, returned=ordinals,
-               from_devices=n_old, to_devices=n_new,
-               probes=ctx.get("probes"), healthy_streak=ctx.get("healthy"))
-    log(f"elastic: ordinals {ordinals} back after {ctx.get('probes')} "
-        f"probe(s) — growing {n_old} -> {n_new} devices at iteration "
-        f"{sig.step}")
+    if cause == "fault":
+        olog.event("device_return", step=sig.step, returned=ordinals,
+                   from_devices=n_old, to_devices=n_new,
+                   probes=ctx.get("probes"),
+                   healthy_streak=ctx.get("healthy"))
+        log(f"elastic: ordinals {ordinals} back after "
+            f"{ctx.get('probes')} probe(s) — growing {n_old} -> {n_new} "
+            f"devices at iteration {sig.step}")
+    else:
+        log(f"elastic: directed grow at iteration {sig.step} — adding "
+            f"ordinals {ordinals}, {n_old} -> {n_new} devices")
     if rebuild is None:
         raise DeviceLostError(
             "elastic regrow needs a model factory: pass "
@@ -642,7 +662,8 @@ def recover_grow(model, sig: DeviceReturnDetected, ctx: Dict, rebuild,
     strategy, research = research_strategy(
         cfg, rebuild, new_machine, ctx.get("pre_strategy"),
         olog=olog, log=log,
-        fallback_strategy=getattr(cfg, "strategies", None))
+        fallback_strategy=getattr(cfg, "strategies", None),
+        objective=objective)
     research_s = time.perf_counter() - t_search
 
     final_cfg = copy.copy(cfg)
@@ -660,7 +681,7 @@ def recover_grow(model, sig: DeviceReturnDetected, ctx: Dict, rebuild,
 
     rec = {
         "step": sig.step, "direction": "grow", "from_devices": n_old,
-        "to_devices": n_new, "returned": ordinals,
+        "to_devices": n_new, "returned": ordinals, "cause": cause,
         "research_s": research_s, "research": research,
         "migration": "in_memory", "resume_step": sig.step,
         "steps_lost": 0, "total_s": time.perf_counter() - t0,
@@ -674,6 +695,76 @@ def recover_grow(model, sig: DeviceReturnDetected, ctx: Dict, rebuild,
     carry = {"start_iter": sig.step, "params": params, "state": state,
              "opt_state": opt_state}
     return new_model, carry, prior
+
+
+# ---------------------------------------------------------------------------
+# directed resize (non-fault entry point for the fleet coordinator)
+
+
+def directed_resize(model, *, keep=None, add=None, step: int,
+                    params, state, opt_state=None, losses=(),
+                    loss_base: int = 0, rebuild, pre_strategy=None,
+                    olog=None, log=print, objective: str = "makespan"):
+    """Resize a HEALTHY running job to an externally-imposed device set —
+    the fleet coordinator's entry into the elastic machinery.  Unlike the
+    fault path there is no classifier, no probe, and no detection record:
+    the caller simply decides the target and this helper synthesizes the
+    control-flow signal :func:`recover` / :func:`recover_grow` expect,
+    invoking them with ``cause="directed"`` so each emits exactly one
+    ``elastic_resize`` record and zero ``device_loss`` /
+    ``device_return`` fault records.
+
+    Exactly one of ``keep`` / ``add`` must be given:
+
+      * ``keep`` — ordinals (into ``model.machine``'s device list) the
+        job retains; the complement is released (a directed SHRINK,
+        routed through :func:`recover`, which still enforces
+        ``--min-devices`` via :class:`ElasticShrinkRefused`);
+      * ``add`` — device OBJECTS granted to the job (a directed GROW,
+        routed through :func:`recover_grow`, warm-started from
+        ``pre_strategy`` when the caller cached one — e.g. the strategy
+        the job ran before an earlier directed shrink).
+
+    ``objective`` selects the re-search pricing (``"makespan"`` for
+    training jobs, ``"latency"`` for serving ones).  ``opt_state`` may
+    be None (serving jobs carry none).  Returns ``(new_model, carry,
+    prior_losses)`` exactly like the fault-path recovery functions."""
+    if (keep is None) == (add is None):
+        raise ValueError(
+            "directed_resize: pass exactly one of keep= (ordinals to "
+            "retain -> shrink) or add= (device objects to adopt -> grow)")
+    if keep is not None:
+        n = model.machine.num_devices
+        keep_set = {int(i) for i in keep}
+        bad = [i for i in keep_set if not 0 <= i < n]
+        if bad:
+            raise ValueError(
+                f"directed_resize: keep ordinals {sorted(bad)} out of "
+                f"range for a {n}-device machine")
+        dead = [i for i in range(n) if i not in keep_set]
+        if not dead:
+            raise ValueError(
+                "directed_resize: keep covers every device — nothing "
+                "to release")
+        sig = DeviceLossDetected(
+            dead, step, params=params, state=state, opt_state=opt_state,
+            losses=losses, loss_base=loss_base)
+        return recover(model, sig, rebuild, olog=olog, log=log,
+                       cause="directed", objective=objective)
+    devs = list(add)
+    if not devs:
+        raise ValueError("directed_resize: add= is empty")
+    sig = DeviceReturnDetected(
+        [_device_ordinal(d) for d in devs], step, params=params,
+        state=state, opt_state=opt_state, losses=losses,
+        loss_base=loss_base)
+    ctx = {
+        "dead": [(d, False) for d in devs],
+        "pre_strategy": pre_strategy,
+        "healthy": 1, "probes": 0, "k": 1, "answering": True,
+    }
+    return recover_grow(model, sig, ctx, rebuild, olog=olog, log=log,
+                        cause="directed", objective=objective)
 
 
 # ---------------------------------------------------------------------------
@@ -738,6 +829,39 @@ def install_drain_handler(drain: Dict, log=print):
         return True
 
     return restore
+
+
+class drain_scope:
+    """Context manager over :func:`install_drain_handler`: the shared
+    install/restore pattern ``apps/serve.py`` and the fleet job runners
+    both need (the third hand-rolled try/finally copy this replaces).
+
+    ::
+
+        with drain_scope(log=log) as drain:
+            ...  # loop checks drain["requested"] at its boundaries
+
+    Yields the drain dict; restores the previous SIGTERM/SIGINT handlers
+    on every exit path (idempotently — an explicit early ``restore()``
+    is also safe)."""
+
+    def __init__(self, log=print, drain: Optional[Dict] = None):
+        self.drain: Dict = drain if drain is not None else {}
+        self._log = log
+        self._restore = None
+
+    def __enter__(self) -> Dict:
+        self._restore = install_drain_handler(self.drain, log=self._log)
+        return self.drain
+
+    def restore(self) -> bool:
+        if self._restore is None:
+            return False
+        return self._restore()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.restore()
+        return False
 
 
 def request_drain(drain: Dict) -> None:

@@ -67,6 +67,16 @@ SERVE_ONLY = {
     "serve_idle_boundaries": "autoscale shrink watermark (apps/serve.py)",
 }
 
+# FFConfig fields that belong to the FLEET coordinator (apps/fleet.py
+# consumes FFConfig.from_args directly).  Single-job training drivers
+# have no pool to arbitrate, so these flags intentionally do not exist
+# on apps/lm.py / apps/nmt.py.
+FLEET_ONLY = {
+    "fleet_quantum": "round-robin steps per job turn (apps/fleet.py)",
+    "fleet_search_budget_s":
+        "arbiter pricing re-search wall cap (apps/fleet.py)",
+}
+
 _BRANCH = re.compile(
     r'(?:el)?if a (?:in \(([^)]*)\)|== "([^"]+)")\s*:(?:\s*#[^\n]*)?\n'
     r"(.*?)"
@@ -112,7 +122,7 @@ def main(argv=None) -> int:
     checked = 0
     serve_exempt = 0
     for flags, fields in entries:
-        if any(f in SERVE_ONLY for f in fields):
+        if any(f in SERVE_ONLY or f in FLEET_ONLY for f in fields):
             serve_exempt += 1
             continue
         exempt = [f for f in fields if f in CNN_ONLY]
@@ -138,7 +148,7 @@ def main(argv=None) -> int:
     print(f"check_flag_forwarding ok: {checked} shared flags present in "
           f"both sequence-driver parsers and forwarded through both "
           f"model configs ({len(entries) - checked - serve_exempt} "
-          f"CNN-only + {serve_exempt} serve-only exemptions)")
+          f"CNN-only + {serve_exempt} serve/fleet-only exemptions)")
     return 0
 
 
