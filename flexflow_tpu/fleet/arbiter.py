@@ -2,17 +2,25 @@
 
 Given the pool size and the fleet's current DEMANDS (each job's
 feasible slice sizes, capped at what it currently bids for), the
-arbiter enumerates candidate packings and picks one.  Two rules order
-the search:
+arbiter packs one slice size per job.  Three rules order the search:
 
-  1. **Work conservation** — only Pareto-MAXIMAL packings compete: a
+  1. **No preemption by omission** — a job that currently HOLDS devices
+     is running on them and there is no evict path, so 0 is never one of
+     its options.  When none of its demand-capped candidates fits
+     at-or-below what it holds (a backlogged binding bid the pool cannot
+     meet), staying at its current size becomes the option — every held
+     job therefore always has a choice <= held, so a feasible packing
+     always exists; a calm job still yields down to its demand.  Only
+     jobs holding nothing may be left unplaced (the coordinator queues
+     them).
+  2. **Work conservation** — only Pareto-MAXIMAL packings compete: a
      packing is discarded if another feasible packing gives every job at
      least as many devices and some job strictly more.  A pool with idle
      devices while a job bids for them is never chosen, which also makes
      each rebalance's outcome structurally determined when demand tiers
      leave a single maximal packing (the deterministic smoke relies on
      exactly this).
-  2. **Weighted predicted cost** — among the maximal packings, minimize
+  3. **Weighted predicted cost** — among the maximal packings, minimize
      ``sum(priority_j * price(job_j, size_j))`` where ``price`` is the
      job's PREDICTED per-step cost on a slice of that size, from the
      native simulator via :func:`sim.search.price_on_slice` — a
@@ -21,6 +29,19 @@ the search:
      the native library is absent the arbiter degrades to a
      deterministic DP proxy (cost proportional to ``1/size``), keeping
      CPU-only CI and the smoke runnable.
+
+The packing itself is a grouped-knapsack DP over (devices used, minimum
+bump-to-next-option) states, polynomial in pool size and job count —
+NOT an enumeration of the Cartesian product of per-job options, which is
+exponential in job count.  It is exact: per-job options are independent
+and the only coupling is ``sum(sizes) <= pool``, so a packing is
+Pareto-dominated iff some SINGLE job can be raised to its next larger
+option within the free capacity — tracking the minimum such bump
+increment alongside devices-used decides maximality per DP state, and
+the score ``(unplaced, Σ priority·price, churn, lexicographic)`` is a
+per-job sum compared lexicographically, which suffix-extension
+preserves (tests cross-check the DP against brute-force enumeration on
+randomized small instances).
 
 Prices are cached per ``(job_id, size)`` — a job's model does not
 change shape between rebalances, so each (job, size) pair is priced at
@@ -31,7 +52,6 @@ so a fixed seed reproduces the identical packing.
 
 from __future__ import annotations
 
-import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
@@ -120,51 +140,81 @@ class Arbiter:
         """Choose a slice size per active job.
 
         ``jobs`` is the admission-ordered list of jobs to place;
-        ``current`` (job_id -> size) marks sizes already held, used only
-        for the tie-break (prefer the packing closest to the incumbent
-        among equal-cost maximal packings, minimizing churn).  Returns
-        ``{job_id: size}``; a job that cannot fit at its minimum in any
-        feasible packing is assigned 0 (the coordinator queues it)."""
+        ``current`` (job_id -> size) marks sizes already held: a held
+        job is RUNNING on its slice, so 0 is never one of its options
+        (no silent preemption — its devices must not be handed away
+        while it keeps running), and staying at its current size is an
+        option exactly when no candidate fits at-or-below it; held
+        sizes also feed the churn tie-break (prefer the packing closest
+        to the incumbent among equal-cost maximal packings).  Returns
+        ``{job_id: size}``; a job holding nothing that cannot fit at
+        its minimum is assigned 0 (the coordinator queues it)."""
         jobs = list(jobs)
         if not jobs:
             return {}
-        options: List[List[int]] = []
-        for job in jobs:
-            # 0 = "not placed" — always an option so one oversized job
-            # cannot make the whole fleet infeasible
-            options.append([0] + job.candidate_sizes(self.pool_size))
-
-        feasible: List[Tuple[int, ...]] = []
-        for combo in itertools.product(*options):
-            if sum(combo) <= self.pool_size:
-                feasible.append(combo)
-        # Pareto-maximal filter: drop any packing dominated by another
-        # (every job >=, some job >) — work conservation
-        maximal = [c for c in feasible
-                   if not any(d != c and all(x >= y for x, y in
-                                             zip(d, c))
-                              for d in feasible)]
-        if not maximal:
-            maximal = feasible
-
-        cur_vec = tuple((current or {}).get(j.spec.job_id, 0)
+        pool = self.pool_size
+        cur_vec = tuple(int((current or {}).get(j.spec.job_id, 0))
                         for j in jobs)
+        options: List[List[int]] = []
+        for job, held in zip(jobs, cur_vec):
+            sizes = job.candidate_sizes(pool)
+            if held:
+                # never 0; and when no candidate fits at-or-below the
+                # held size (a backlogged binding bid the pool cannot
+                # meet), staying put is the option — so every held job
+                # always has a choice <= held and a feasible packing
+                # exists.  Demand-capped candidates are NOT extended
+                # otherwise: a calm serve job must still yield down.
+                if not any(s <= held for s in sizes):
+                    sizes = sorted(set(sizes) | {held})
+                options.append(sizes)
+            else:
+                # 0 = "not placed" — an option only for jobs holding
+                # nothing, so one oversized job cannot make the whole
+                # fleet infeasible
+                options.append([0] + sizes)
 
-        def score(combo: Tuple[int, ...]):
-            unplaced = sum(1 for s in combo if s == 0)
-            cost = 0.0
-            for job, size in zip(jobs, combo):
-                if size:
-                    cost += job.spec.priority * self.price(job, size)
-            churn = sum(1 for a, b in zip(combo, cur_vec) if a != b)
-            # placing a job always beats idling it (a packing's cost sum
-            # cannot see the job it dropped); then weighted predicted
-            # cost, then least churn, then the lexicographically
-            # smallest vector: fully deterministic
-            return (unplaced, cost, churn, combo)
-
-        best = min(maximal, key=score)
-        return {j.spec.job_id: s for j, s in zip(jobs, best)}
+        # Grouped-knapsack DP, one group per job in admission order.
+        # State: (devices used, min bump) where "bump" is the smallest
+        # increment that would raise ONE chosen job to its next larger
+        # option — a final packing is Pareto-maximal iff its min bump
+        # exceeds the free capacity.  Value: the partial score
+        # (unplaced, Σ priority·price, churn, combo-prefix); keeping
+        # the minimum per state is exact because the score is additive
+        # and suffix-extension preserves its lexicographic order.
+        INF = pool + 1   # caps bump: anything > pool acts as "no bump"
+        states: Dict[Tuple[int, int], tuple] = {(0, INF): (0, 0.0, 0, ())}
+        for idx, (job, opts) in enumerate(zip(jobs, options)):
+            nxt: Dict[Tuple[int, int], tuple] = {}
+            for (used, bump), val in states.items():
+                for i, s in enumerate(opts):
+                    nu = used + s
+                    if nu > pool:
+                        break               # opts ascend: rest too big
+                    nb = min(bump, min(opts[i + 1] - s, INF)
+                             if i + 1 < len(opts) else INF)
+                    if s:
+                        nval = (val[0],
+                                val[1] + job.spec.priority
+                                * self.price(job, s),
+                                val[2] + (s != cur_vec[idx]),
+                                val[3] + (s,))
+                    else:
+                        nval = (val[0] + 1, val[1],
+                                val[2] + (cur_vec[idx] != 0),
+                                val[3] + (0,))
+                    key = (nu, nb)
+                    if key not in nxt or nval < nxt[key]:
+                        nxt[key] = nval
+            states = nxt
+        # work conservation: only maximal finals compete (some always
+        # exist — the all-current/all-zero packing is feasible, and the
+        # best value at any maximal packing's state is itself maximal)
+        best = min((val for (used, bump), val in states.items()
+                    if bump > pool - used), default=None)
+        if best is None:     # unreachable; insurance over a crash
+            best = min(states.values())
+        return {j.spec.job_id: s for j, s in zip(jobs, best[3])}
 
     def assign_ordinals(self, jobs: Sequence, sizes: Dict[str, int],
                         *, current: Optional[Dict[str, List[int]]] = None
@@ -180,6 +230,19 @@ class Arbiter:
         current = dict(current or {})
         taken: set = set()
         out: Dict[str, List[int]] = {}
+        # pass 0: a job that still holds devices but was packed at 0
+        # keeps its slice, reserved — it is RUNNING there and there is
+        # no evict path, so handing its ordinals to anyone else would
+        # silently oversubscribe the pool.  pack() never produces this
+        # (held jobs have no 0 option); guard it anyway.
+        for job in jobs:
+            jid = job.spec.job_id
+            held = sorted(current.get(jid, []))
+            if held and not sizes.get(jid, 0):
+                self.log(f"fleet: packing assigned 0 to running job "
+                         f"{jid}; it keeps its {len(held)}-device slice")
+                out[jid] = held
+                taken.update(held)
         # pass 1: shrinking / steady jobs keep a prefix
         for job in jobs:
             jid = job.spec.job_id
@@ -227,4 +290,14 @@ class Arbiter:
                     f"{len(avail)} free devices (arbiter bug)")
             out[jid] = avail[:size]
             taken.update(out[jid])
+        # the disjointness contract: no ordinal in two jobs' slices —
+        # violating it is the one bug class worse than a crash
+        seen: set = set()
+        for jid, ords in out.items():
+            dup = seen & set(ords)
+            if dup:
+                raise RuntimeError(
+                    f"fleet: assignment oversubscribes ordinals "
+                    f"{sorted(dup)} (job {jid}) — arbiter bug")
+            seen.update(ords)
         return out

@@ -22,7 +22,11 @@ boring — determinism is the feature:
      demand vector triggers a re-pack; if the assignment actually
      changes, a ``fleet_rebalance`` record is written and the moves
      execute as DIRECTED resizes — all shrinks before all grows, so the
-     pool never oversubscribes mid-transition.
+     pool never oversubscribes mid-transition.  Every move is checked
+     against the ordinals OTHER jobs actually hold before it executes:
+     if an earlier move failed (the job aborted back to running on its
+     old slice), dependent moves are deferred and the next round
+     re-packs from the true pool state rather than the stale plan.
 
 Drain rides the same dict the elastic runtime uses: SIGTERM sets
 ``drain["requested"]``, every job winds down at its next boundary, and
@@ -117,6 +121,17 @@ class FleetCoordinator:
         return tuple((j.spec.job_id, j.demand(self.pool.num_devices))
                      for j in self._placeable())
 
+    def _held_by_others(self, job) -> set:
+        """Pool ordinals ACTUALLY held right now by every active job
+        except ``job`` — the ground truth a planned move must be
+        disjoint from before it executes (a failed earlier move means
+        the plan's assumptions about freed devices no longer hold)."""
+        held: set = set()
+        for j in self.jobs:
+            if j is not job and j.active:
+                held.update(j.ordinals)
+        return held
+
     def _pack(self) -> Dict[str, int]:
         jobs = self._placeable()
         sizes = self.arbiter.pack(jobs, current=self._current_sizes())
@@ -197,6 +212,7 @@ class FleetCoordinator:
                 placements.append((job, new))
         if not moves and not placements:
             return
+        degraded = False
         if moves:
             self.rebalances += 1
             # the rebalance record precedes the elastic_resize records
@@ -214,19 +230,45 @@ class FleetCoordinator:
             moves.sort(key=lambda m: (len(m[1]) - len(m[0].ordinals),
                                       m[0].spec.job_id))
             for job, new in moves:
+                # the plan was priced against devices earlier moves
+                # were to free; if one failed, its devices were never
+                # released — defer any move that would oversubscribe
+                conflict = set(new) & self._held_by_others(job)
+                if conflict:
+                    self.log(f"fleet: deferring resize of "
+                             f"{job.spec.job_id} -> {new}: ordinals "
+                             f"{sorted(conflict)} still held by "
+                             f"another job")
+                    degraded = True
+                    continue
                 try:
                     job.resize(self.pool, new)
                 except Exception as e:  # noqa: BLE001
+                    # Job.resize aborts back to running on the slice
+                    # its completed legs left it holding
                     self.log(f"fleet: resize of {job.spec.job_id} "
-                             f"failed ({e}); job keeps its current "
-                             f"slice")
+                             f"failed ({e}); job resumes on its "
+                             f"{len(job.ordinals)}-device slice")
+                    degraded = True
         # queued jobs admitted by the re-pack place after the shrinks
         # that freed their devices
         for job, ords in placements:
+            conflict = set(ords) & self._held_by_others(job)
+            if conflict:
+                self.log(f"fleet: deferring placement of "
+                         f"{job.spec.job_id}: ordinals "
+                         f"{sorted(conflict)} still held by another "
+                         f"job")
+                degraded = True
+                continue
             job.place(self.pool, ords,
                       strategy=self.arbiter.priced_strategy(
                           job, len(ords)),
                       drain=self._drain)
+        if degraded:
+            # the pool is not in the packed shape — force a re-pack at
+            # the next round instead of waiting for a demand shift
+            self._demand_key = None
         if self.metrics is not None:
             self.metrics.update(fleet_rebalances_total=self.rebalances)
         self._update_metrics()

@@ -14,6 +14,9 @@ where the parenthesized loop is one DIRECTED resize (coordinator-
 imposed, ``utils.elastic.directed_resize`` — never the fault
 classifier): the job drains to its next step boundary, the elastic
 machinery regrids its live state onto the new slice, and it resumes.
+A failed resize leg takes the ABORT edge ``draining -> running``: the
+job resumes on the slice the completed legs left it holding (the
+exception still propagates so the coordinator can re-pack).
 
 Two runner shapes:
 
@@ -49,7 +52,9 @@ _TRANSITIONS = {
     "pending": ("placing", "failed"),
     "placing": ("running", "failed"),
     "running": ("draining", "done", "failed"),
-    "draining": ("resized", "done", "failed"),
+    # draining -> running is the resize ABORT path: a leg failed, the
+    # job resumes on whatever slice the completed legs left it holding
+    "draining": ("resized", "running", "done", "failed"),
     "resized": ("running", "failed"),
     "done": (),
     "failed": (),
@@ -325,7 +330,14 @@ class Job:
         is one shrink or one grow; a sideways move (partial overlap)
         decomposes into shrink-to-intersection + grow — each leg emits
         one ``elastic_resize`` record on the job's stream.  Walks the
-        lifecycle running -> draining -> resized -> running."""
+        lifecycle running -> draining -> resized -> running.
+
+        A failed leg re-raises, but FIRST resumes the job running on
+        the slice the completed legs actually left it holding (each leg
+        swaps the model only on success, so that slice is live) and
+        updates ``self.ordinals`` to match — the job is never stranded
+        in ``draining``, and the coordinator can see which devices the
+        failed move really freed."""
         new = sorted(int(i) for i in new_ordinals)
         old = list(self.ordinals)
         if new == old:
@@ -339,11 +351,17 @@ class Job:
         self.to_state("draining", target=new)
         legs = []
         inter = sorted(set(new) & set(old))
-        if inter != old:          # release what the target drops
-            legs.append(self._resize_leg(pool, inter, old))
-        if new != inter:          # adopt what the target adds
-            legs.append(self._resize_leg(pool, new, inter))
-        self.ordinals = new
+        try:
+            if inter != old:      # release what the target drops
+                legs.append(self._resize_leg(pool, inter, old))
+                self.ordinals = inter
+            if new != inter:      # adopt what the target adds
+                legs.append(self._resize_leg(pool, new, inter))
+            self.ordinals = new
+        except Exception as e:  # noqa: BLE001 — abort, resume in place
+            self.to_state("running", resize_failed=f"{type(e).__name__}",
+                          ordinals=list(self.ordinals))
+            raise
         self.to_state("resized", ordinals=new,
                       directions=[r["direction"] for r in legs])
         self.to_state("running")
@@ -382,7 +400,7 @@ class Job:
             self._sharding = _batch_sharding(new_model.machine)
         else:
             eng = self.engine
-            step = eng._sess["steps"] if eng._sess else 0
+            step = eng.session_steps()
             new_model, carry, _ = directed_resize(
                 self.model, step=step, params=eng.params,
                 state=eng.state, opt_state=None, losses=(),

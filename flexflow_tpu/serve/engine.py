@@ -201,12 +201,22 @@ class ServeEngine:
         s = getattr(self, "_sess", None)
         return int(s["queue"].depth(s["vnow"])) if s is not None else 0
 
+    def session_steps(self) -> int:
+        """Decode steps taken by the open session (0 when none is
+        open) — the step counter the fleet job stamps on a directed
+        resize."""
+        s = self._sess
+        return int(s["steps"]) if s is not None else 0
+
     def step_once(self) -> bool:
         """One scheduling boundary of the open session: drain check,
         admission, watermark triggers, then at most one decode step.
         Returns True while work remains, False once the session is
         exhausted (call :meth:`finish` then)."""
         s = self._sess
+        if s is None:
+            raise RuntimeError("serve: no open session — call start() "
+                               "before step_once()")
         if s["done"]:
             return False
         queue, batcher = s["queue"], s["batcher"]
@@ -286,8 +296,13 @@ class ServeEngine:
         return True
 
     def finish(self) -> Dict:
-        """Close the session: emit ``serve_summary`` and return it."""
+        """Close the session: emit ``serve_summary`` and return it.
+        Closing is one-shot — a second finish() (or one without a
+        start()) raises rather than dying on an opaque TypeError."""
         s = self._sess
+        if s is None:
+            raise RuntimeError("serve: no open session — start() was "
+                               "never called or finish() already ran")
         self._sess = None
         return self._summarize(s["completed"], s["unserved"], s["vnow"],
                                s["steps"],

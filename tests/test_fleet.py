@@ -551,3 +551,228 @@ def test_drain_scope_installs_and_restores():
         assert not drain.get("requested")
         assert signal.getsignal(signal.SIGTERM) is not before
     assert signal.getsignal(signal.SIGTERM) is before
+
+
+# ---------------------------------------------------------------------------
+# arbiter: running jobs are never zeroed; the knapsack DP is exact
+
+
+class _StubSpec:
+    def __init__(self, job_id, priority):
+        self.job_id, self.priority = job_id, priority
+
+
+class _StubJob:
+    """Job-shaped stub: just an id, a priority and a size menu."""
+
+    def __init__(self, job_id, sizes, priority=1.0):
+        self.spec = _StubSpec(job_id, float(priority))
+        self._sizes = sorted(sizes)
+
+    def candidate_sizes(self, pool):
+        return [s for s in self._sizes if s <= pool]
+
+
+def test_pack_never_zeroes_a_held_job():
+    """The review scenario: a running train job with a high min vs a
+    backlogged serve job whose binding bid wants the whole pool.  Both
+    all-or-nothing packings would leave one job unplaced — but zeroing
+    the RUNNING job would hand its devices away while it keeps running
+    (there is no evict path), silently oversubscribing the pool.  A
+    held job's options never include 0 — and a binding bid the pool
+    cannot meet gains stay-put as its fallback — so the only feasible
+    packing keeps everyone in place."""
+    t = Job(_train_spec("t", min_devices=6, max_devices=6))
+    s = Job(_serve_spec("s", min_devices=2, max_devices=8))
+
+    class _Eng:
+        def queue_depth(self):
+            return 99
+
+    s.engine = _Eng()
+    assert s.candidate_sizes(8) == [8]     # binding backlogged bid
+    arb = Arbiter(8, pricer=_proxy_pricer, log=lambda *a: None)
+    sizes = arb.pack([t, s], current={"t": 6, "s": 2})
+    assert sizes == {"t": 6, "s": 2}       # nobody running is zeroed
+
+
+def test_assign_ordinals_reserves_zero_packed_running_slice():
+    """Defense in depth below pack(): even a (buggy) packing that zeroes
+    a still-running job must not hand its slice to anyone else — the
+    held ordinals stay reserved, and a grow that cannot proceed without
+    them fails loudly instead of oversubscribing."""
+    t, s = Job(_train_spec("t")), Job(_serve_spec("s"))
+    arb = Arbiter(10, pricer=_proxy_pricer, log=lambda *a: None)
+    out = arb.assign_ordinals(
+        [t, s], {"t": 0, "s": 4},
+        current={"t": [0, 1, 2, 3, 4, 5], "s": [6, 7]})
+    assert out["t"] == [0, 1, 2, 3, 4, 5]  # kept, reserved
+    assert out["s"] == [6, 7, 8, 9]        # grew around it
+    assert not set(out["t"]) & set(out["s"])
+
+    arb8 = Arbiter(8, pricer=_proxy_pricer, log=lambda *a: None)
+    with pytest.raises(RuntimeError):
+        arb8.assign_ordinals(
+            [t, s], {"t": 0, "s": 4},
+            current={"t": [0, 1, 2, 3, 4, 5], "s": [6, 7]})
+
+
+def test_pack_matches_bruteforce_reference():
+    """The grouped-knapsack DP is exact: identical output to brute-force
+    enumeration (Cartesian product + Pareto-maximal filter + the
+    (unplaced, cost, churn, lex) score) on randomized small fleets."""
+    import itertools
+
+    rng = np.random.RandomState(11)
+
+    def pricer(job, size):
+        k = 1.0 + 0.25 * (ord(job.spec.job_id[-1]) % 5)
+        return k / size + 0.001 * size
+
+    def reference(jobs, pool, current):
+        cur_vec = tuple(int(current.get(j.spec.job_id, 0))
+                        for j in jobs)
+        options = []
+        for job, held in zip(jobs, cur_vec):
+            sizes = job.candidate_sizes(pool)
+            if held:
+                if not any(s <= held for s in sizes):
+                    sizes = sorted(set(sizes) | {held})
+                options.append(sizes)
+            else:
+                options.append([0] + sizes)
+        feasible = [c for c in itertools.product(*options)
+                    if sum(c) <= pool]
+        maximal = [c for c in feasible
+                   if not any(d != c and all(x >= y for x, y in
+                                             zip(d, c))
+                              for d in feasible)] or feasible
+
+        def score(combo):
+            cost = 0.0
+            for job, sz in zip(jobs, combo):
+                if sz:
+                    cost += job.spec.priority * pricer(job, sz)
+            return (sum(1 for sz in combo if sz == 0), cost,
+                    sum(1 for x, y in zip(combo, cur_vec) if x != y),
+                    combo)
+
+        best = min(maximal, key=score)
+        return {j.spec.job_id: sz for j, sz in zip(jobs, best)}
+
+    for trial in range(40):
+        pool = int(rng.randint(4, 11))
+        jobs, current, free = [], {}, pool
+        for i in range(int(rng.randint(1, 5))):
+            jid = f"j{trial}x{i}"
+            sizes = sorted(rng.choice(range(1, pool + 1),
+                                      size=int(rng.randint(1, 4)),
+                                      replace=False).tolist())
+            jobs.append(_StubJob(jid, sizes,
+                                 rng.choice([1.0, 2.0, 5.0])))
+            if free > 0 and rng.rand() < 0.5:
+                held = int(rng.randint(1, free + 1))
+                current[jid] = held
+                free -= held
+        arb = Arbiter(pool, pricer=pricer, log=lambda *a: None)
+        got = arb.pack(jobs, current=current)
+        want = reference(jobs, pool, current)
+        assert got == want, (trial, pool, current, got, want)
+
+
+def test_pack_polynomial_in_job_count():
+    """16 jobs x 4 options is ~4^16 combos under the old Cartesian
+    enumeration; the DP packs them near-instantly."""
+    import time as _time
+
+    jobs = [_StubJob(f"j{i:02d}", [1, 2, 4]) for i in range(16)]
+    arb = Arbiter(32, pricer=lambda job, size: 1.0 / size,
+                  log=lambda *a: None)
+    t0 = _time.monotonic()
+    sizes = arb.pack(jobs)
+    assert _time.monotonic() - t0 < 5.0
+    assert sum(sizes.values()) == 32       # work conserving
+    assert all(s in (1, 2, 4) for s in sizes.values())
+
+
+# ---------------------------------------------------------------------------
+# resize failure: abort back to running, never strand or oversubscribe
+
+
+def test_resize_failure_aborts_back_to_running(tmp_path, monkeypatch):
+    """A failed resize leg must not strand the job in 'draining': it
+    resumes RUNNING on the slice it actually holds (the exception still
+    propagates), and it keeps stepping afterwards."""
+    from flexflow_tpu import obs
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.utils import elastic
+
+    path = str(tmp_path / "job.jsonl")
+    olog = obs.RunLog(path, surface="fit")
+    pool = MachineModel()
+    job = Job(_train_spec("a"), olog=olog, log=lambda *a: None)
+    job.place(pool, [0, 1, 2, 3, 4, 5])
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected rebuild failure")
+
+    monkeypatch.setattr(elastic, "directed_resize", boom)
+    with pytest.raises(RuntimeError, match="injected rebuild failure"):
+        job.resize(pool, [0, 1, 2, 3])
+    assert job.state == "running"
+    assert job.ordinals == [0, 1, 2, 3, 4, 5]
+    assert job.step_quantum(1) is True     # still alive and stepping
+    olog.close()
+    states = [(r["state"], r["from_state"])
+              for r in obs.read_run(path)
+              if r["kind"] == "fleet_job" and "from_state" in r]
+    assert states[-2:] == [("draining", "running"),
+                           ("running", "draining")]
+    abort = [r for r in obs.read_run(path)
+             if r["kind"] == "fleet_job" and r.get("resize_failed")]
+    assert len(abort) == 1
+
+
+def test_coordinator_resize_failure_no_oversubscription(monkeypatch):
+    """When every directed resize fails, the fleet degrades instead of
+    corrupting: the shrinking job aborts back to its slice, dependent
+    grows are deferred (their target ordinals are still held), no two
+    jobs ever hold the same ordinal, and both jobs still finish."""
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.utils import elastic
+
+    coord = FleetCoordinator(MachineModel(), quantum=2,
+                             pricer=_proxy_pricer, log=lambda *a: None)
+    a = coord.submit(_train_spec("a", iters=10, max_devices=6))
+    b = coord.submit(_train_spec("b", iters=10, min_devices=2,
+                                 max_devices=2))
+    orig_demand = Job.demand
+
+    def shifting_demand(self, pool_size):
+        if self is b and self.iters_done >= 2:
+            self.spec.max_devices = 4
+        return orig_demand(self, pool_size)
+
+    def failing_resize(*args, **kw):
+        raise RuntimeError("injected resize failure")
+
+    overlaps = []
+    orig_quantum = Job.step_quantum
+
+    def checked_quantum(self, n, drain=None):
+        held = [set(j.ordinals) for j in (a, b) if j.active]
+        if len(held) == 2 and held[0] & held[1]:
+            overlaps.append(sorted(held[0] & held[1]))
+        return orig_quantum(self, n, drain)
+
+    monkeypatch.setattr(Job, "demand", shifting_demand)
+    monkeypatch.setattr(Job, "step_quantum", checked_quantum)
+    monkeypatch.setattr(elastic, "directed_resize", failing_resize)
+    summary = coord.run()
+    assert overlaps == []                  # never oversubscribed
+    assert summary["by_state"] == {"done": 2}
+    devs = {j["job"]: j["devices"] for j in summary["jobs"]}
+    assert devs == {"a": 6, "b": 2}        # every move failed in place
+    assert summary["rebalances"] >= 1
+    for j in summary["jobs"]:
+        assert math.isfinite(j["final_loss"])

@@ -481,3 +481,31 @@ def test_forward_only_service_cnn_shapes(tiny_lm, machine8):
     assert all(r.reply is not None for r in reqs)
     assert all(r.done_v is not None and r.done_v > r.arrival_v
                for r in reqs)
+
+
+def test_engine_session_guards_and_public_steps(tiny_lm):
+    """step_once()/finish() outside an open session raise a clear
+    RuntimeError (not an opaque TypeError), finish() is one-shot, and
+    session_steps() is the public step counter the fleet job reads."""
+    from flexflow_tpu.serve.engine import ServeEngine
+
+    model, _ = tiny_lm
+    eng = ServeEngine(model, None, log=lambda *a: None)
+    with pytest.raises(RuntimeError, match="no open session"):
+        eng.step_once()
+    with pytest.raises(RuntimeError, match="no open session"):
+        eng.finish()
+    assert eng.session_steps() == 0
+    reqs = synthetic_requests(2, seed=8, rate_qps=1000.0, vocab_size=64,
+                              prompt_len=4, max_new_tokens=2)
+    eng.start(reqs)
+    while eng.step_once():
+        pass
+    assert eng.session_steps() > 0
+    summary = eng.finish()
+    assert summary["completed"] == 2
+    assert eng.session_steps() == 0
+    with pytest.raises(RuntimeError, match="no open session"):
+        eng.finish()                      # closing is one-shot
+    with pytest.raises(RuntimeError, match="no open session"):
+        eng.step_once()                   # and the session is gone
