@@ -3,7 +3,7 @@ PYTHON ?= python
 
 .PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
 	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke \
-	serve-smoke fleet-smoke
+	serve-smoke fleet-smoke loadtest-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -17,7 +17,7 @@ native:
 # every emitted obs record kind must be rendered by obs/report.py and
 # covered by a test (tools/check_obs_kinds.py), and the static strategy
 # verifier must come up clean (lint)
-check: lint fusion-smoke serve-smoke fleet-smoke
+check: lint fusion-smoke serve-smoke fleet-smoke loadtest-smoke
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
@@ -156,6 +156,40 @@ serve-smoke:
 	assert rec['devices'] == 8, rec; \
 	print('serve-smoke ok:', {k: rec[k] for k in \
 	('completed','qps','p50_s','p99_s','resizes','devices')})"
+
+# sustained-load harness smoke (serving observability round): a small
+# deterministic device-count sweep of the patterned load generator
+# through the engine; asserts exactly one bench-convention JSON stdout
+# line (metric/value/unit/vs_baseline), finite TTFT/TPOT/p50/p99, the
+# SLO burn rate present, >= 3 sweep points, a validated Perfetto trace,
+# and a written serve_bench_v1 artifact matching the metric line (the
+# committed SERVE_r01.json is the same harness at full size)
+loadtest-smoke:
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.loadtest --smoke \
+	--out /tmp/ff-loadtest-smoke.json \
+	| $(PYTHON) -c "import json,math,sys; \
+	rec=json.loads(sys.stdin.readline()); \
+	assert sys.stdin.readline() == '', 'stdout must be one JSON line'; \
+	assert all(k in rec for k in \
+	('metric','value','unit','vs_baseline')), rec; \
+	assert rec['unit'] == 'req/s', rec; \
+	assert all(math.isfinite(rec[k]) for k in \
+	('value','p50_s','p99_s','ttft_p50_s','ttft_p99_s','tpot_p50_s', \
+	'burn_rate','goodput_qps')), rec; \
+	assert rec['sweep_points'] >= 3, rec; \
+	assert rec['trace_validated'] is True, rec; \
+	art=json.load(open(rec['out'])); \
+	assert art['schema'] == 'serve_bench_v1', art; \
+	assert art['parsed']['metric'] == rec['metric'] \
+	and art['parsed']['value'] == rec['value'], art['parsed']; \
+	assert len(art['sweep']) == rec['sweep_points'], art; \
+	assert all(math.isfinite(p[k]) for p in art['sweep'] for k in \
+	('qps','p50_s','p99_s','ttft_p50_s','tpot_p50_s','goodput_qps')), art; \
+	print('loadtest-smoke ok:', {k: rec[k] for k in \
+	('metric','value','vs_baseline','sweep_points','p99_s', \
+	'ttft_p50_s','burn_rate','trace_validated')})"
 
 # multi-tenant fleet smoke (fleet/ round): two jobs on the 8-device
 # simulated pool trade devices mid-run — training job A shrinks 6->4

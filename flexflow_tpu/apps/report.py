@@ -6,7 +6,10 @@
     python -m flexflow_tpu.apps.report budget <run.jsonl|obs_dir ...> \\
         [--json]
     python -m flexflow_tpu.apps.report serve <run.jsonl|obs_dir ...> \\
-        [--json]
+        [--json] [--trace OUT.trace.json]
+    python -m flexflow_tpu.apps.report slo <run.jsonl|obs_dir ...> \\
+        [--target-s X] [--availability Y] [--window-s W] \\
+        [--percentile P] [--json]
 
 Default mode renders a run's JSONL event stream (FFConfig.obs_dir /
 RunLog output, a search-trace artifact, or a bench log) into the summary
@@ -42,7 +45,17 @@ mode) expands to every ``*.jsonl`` stream inside it, so
 
 The ``serve`` subcommand renders a serving run's ``serve_*`` records
 (apps/serve.py -obs-dir): per-request latency histogram + p50/p90/p99,
-batch-occupancy curve, and the queue-driven autoscale resizes.
+TTFT/TPOT percentiles, batch-occupancy curve, and the queue-driven
+autoscale resizes.  ``--trace OUT.trace.json`` additionally exports the
+per-request Perfetto lanes (queue-wait span -> decode span per rid,
+admission-batch flow arrows, queue/slots/KV-occupancy counters — plus
+fleet device-occupancy lanes when the stream carries ``fleet_*``
+records), validated before writing.
+
+The ``slo`` subcommand evaluates a latency SLO over the stream's
+``serve_request`` records (obs/slo.py): whole-stream and worst-window
+error-budget burn rate, achieved percentile, goodput-under-SLO.  Exit 1
+when the stream has no completed requests.
 """
 
 from __future__ import annotations
@@ -286,18 +299,49 @@ def fusions_main(argv, log=print) -> int:
 
 def serve_main(argv, log=print) -> int:
     """The serving pass (``report serve``): render the latency histogram
-    + percentiles, batch occupancy, and autoscale resizes of a serving
-    run's ``serve_*`` records (apps/serve.py -obs-dir).  Exit 1 when the
-    stream carries no serving records."""
+    + percentiles (latency, TTFT, TPOT), batch occupancy, and autoscale
+    resizes of a serving run's ``serve_*`` records (apps/serve.py
+    -obs-dir).  ``--trace OUT.trace.json`` exports the per-request
+    Perfetto lanes (+ fleet lanes when present), validated before
+    writing.  Exit 1 when the stream carries no serving records."""
     from flexflow_tpu.obs.report import _serve_section, summarize
 
     json_out = "--json" in argv
-    paths = [a for a in argv if not a.startswith("-")]
+    trace_out = None
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--trace":
+            i += 1
+            if i >= len(argv):
+                raise SystemExit("flag '--trace' expects a value")
+            trace_out = argv[i]
+        elif not a.startswith("-"):
+            paths.append(a)
+        i += 1
     if not paths:
         log(serve_main.__doc__.strip())
         return 2
     events, _ = _read_paths(paths, log)
     events.sort(key=lambda e: e.get("ts", 0.0))
+    if trace_out:
+        from flexflow_tpu.obs import trace as obstrace
+
+        lanes = [obstrace.serve_trace_events(events)]
+        if any(e.get("kind") in ("fleet_job", "fleet_rebalance")
+               for e in events):
+            lanes.append(obstrace.fleet_trace_events(events))
+        trace = obstrace.chrome_trace(*lanes)
+        errors = obstrace.validate_trace(trace)
+        if errors:
+            for e in errors:
+                log(f"trace invalid: {e}")
+            return 1
+        obstrace.write_trace(trace_out, trace)
+        log(f"written: {trace_out} "
+            f"({len(trace['traceEvents'])} events; open in "
+            f"ui.perfetto.dev)")
     if json_out:
         s = summarize(events).get("serve")
         log(json.dumps(s or {}))
@@ -311,6 +355,66 @@ def serve_main(argv, log=print) -> int:
     return 0
 
 
+def slo_main(argv, log=print) -> int:
+    """The SLO pass (``report slo``): evaluate a latency SLO over the
+    stream's ``serve_request`` records — whole-stream + worst-window
+    error-budget burn rate, achieved percentile, goodput-under-SLO.
+    Spec via ``--target-s`` / ``--availability`` / ``--window-s`` /
+    ``--percentile``.  Exit 1 when the stream has no completed
+    requests."""
+    from flexflow_tpu.obs.slo import SLOSpec, burn_rate_windows, evaluate
+
+    json_out = "--json" in argv
+    spec_kw = {}
+    flags = {"--target-s": ("latency_target_s", float),
+             "--availability": ("availability", float),
+             "--window-s": ("window_s", float),
+             "--percentile": ("percentile", float),
+             "--name": ("name", str)}
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in flags:
+            i += 1
+            if i >= len(argv):
+                raise SystemExit(f"flag {a!r} expects a value")
+            key, cast = flags[a]
+            spec_kw[key] = cast(argv[i])
+        elif not a.startswith("-"):
+            paths.append(a)
+        i += 1
+    if not paths:
+        log(slo_main.__doc__.strip())
+        return 2
+    spec = SLOSpec(**spec_kw)
+    events, _ = _read_paths(paths, log)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    result = evaluate(events, spec)
+    if not result["total"]:
+        log("no completed serve_request records in the stream(s): run "
+            "apps/serve.py or apps/loadtest.py with -obs-dir set")
+        return 1
+    if json_out:
+        result["window_detail"] = burn_rate_windows(events, spec)
+        log(json.dumps(result))
+        return 0
+    s = result["spec"]
+    log(f"slo[{s['name']}]: p{s['percentile']:g} latency <= "
+        f"{s['latency_target_s']}s, availability {s['availability']}")
+    log(f"  requests: {result['total']} ({result['violations']} over "
+        f"target -> error rate {result['error_rate']:.4f} of budget "
+        f"{result['error_budget']:.4f})")
+    log(f"  burn rate: {result['burn_rate']:.2f}x overall, worst "
+        f"{s['window_s']:g}s window {result['max_window_burn_rate']:.2f}x "
+        f"({result['windows']} windows)")
+    ach = result["achieved_percentile_s"]
+    log(f"  achieved p{s['percentile']:g}: {ach:.4f}s -> "
+        f"{'COMPLIANT' if result['compliant'] else 'VIOLATED'}, "
+        f"goodput {result['goodput_qps']:.1f} qps")
+    return 0
+
+
 def main(argv=None, log=print) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "trace":
@@ -321,6 +425,8 @@ def main(argv=None, log=print) -> int:
         return fusions_main(argv[1:], log)
     if argv and argv[0] == "serve":
         return serve_main(argv[1:], log)
+    if argv and argv[0] == "slo":
+        return slo_main(argv[1:], log)
     json_out = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:

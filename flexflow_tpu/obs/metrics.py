@@ -75,7 +75,21 @@ _HELP = {
     "latency_p50_s": "serving request latency p50 (virtual seconds, "
                      "arrival to completion)",
     "latency_p99_s": "serving request latency p99 (virtual seconds)",
+    "ttft_p50_s": "serving time-to-first-token p50 (virtual seconds, "
+                  "arrival to first decoded token)",
+    "ttft_p99_s": "serving time-to-first-token p99 (virtual seconds)",
+    "tpot_p50_s": "serving time-per-output-token p50 (virtual seconds "
+                  "per decode token after the first)",
     "requests_total": "serving requests completed this run",
+    "slo_burn_rate": "SLO error-budget burn rate over the full stream "
+                     "(1.0 = burning exactly the budget)",
+    "slo_max_window_burn_rate": "worst rolling-window SLO burn rate",
+    "slo_error_rate": "fraction of requests violating the SLO latency "
+                      "target",
+    "slo_goodput_qps": "SLO-compliant completed requests per virtual "
+                       "second",
+    "slo_compliant": "1 if the achieved latency percentile meets the "
+                     "SLO target, else 0",
     "fleet_jobs": "fleet jobs by lifecycle state, exported as "
                   "ff_fleet_jobs{state=...}; the plain series is the "
                   "total job count",
@@ -89,6 +103,19 @@ _COUNTER_EXTRA = {"fleet_rebalances_total"}
 _COUNTERS = {"steps_total", "rollbacks_total", "faults_total",
              "prefetch_stall_seconds_total", "elastic_events",
              "requests_total"} | _COUNTER_EXTRA
+
+# Fixed log-spaced latency buckets: 1 ms .. 100 s in quarter-decade
+# steps (21 finite upper bounds + the implicit +Inf).  Fixed — never
+# derived from observed data — so scrapes from different replicas
+# aggregate bucket-for-bucket.
+LATENCY_BUCKETS = tuple(round(0.001 * 10 ** (i / 4), 10)
+                        for i in range(21))
+
+_HIST_HELP = {
+    "request_latency_s": "serving request latency (virtual seconds, "
+                         "arrival to completion)",
+    "request_ttft_s": "serving time-to-first-token (virtual seconds)",
+}
 
 
 def _finite(v) -> Optional[float]:
@@ -118,6 +145,10 @@ class MetricsExporter:
         # published right after the same-named plain series (which stays
         # the all-directions total, so unlabeled dashboards keep working)
         self.labeled: Dict[str, Dict[str, float]] = {}
+        # histograms: bare name -> {"counts": per-bucket (non-cumulative,
+        # +Inf last), "sum": float, "count": int}; buckets are the fixed
+        # LATENCY_BUCKETS so replicas aggregate bucket-for-bucket
+        self.histograms: Dict[str, Dict] = {}
         self._writes = 0
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
@@ -133,6 +164,25 @@ class MetricsExporter:
         ``ff_elastic_events{direction="grow"} 1``."""
         key = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
         self.labeled.setdefault(name, {})[key] = value
+
+    def observe(self, name: str, value) -> None:
+        """Record one sample into the ``name`` histogram (fixed
+        LATENCY_BUCKETS).  Non-finite samples are dropped — same
+        poisoned-value contract as the gauges."""
+        f = _finite(value)
+        if f is None:
+            return
+        h = self.histograms.setdefault(
+            name, {"counts": [0] * (len(LATENCY_BUCKETS) + 1),
+                   "sum": 0.0, "count": 0})
+        for i, le in enumerate(LATENCY_BUCKETS):
+            if f <= le:
+                h["counts"][i] += 1
+                break
+        else:
+            h["counts"][-1] += 1  # +Inf bucket
+        h["sum"] += f
+        h["count"] += 1
 
     def finite_values(self) -> Dict[str, float]:
         out = {}
@@ -166,6 +216,20 @@ class MetricsExporter:
                 f = _finite(v)
                 if f is not None:
                     lines.append(f"{name}{{{labels}}} {f:.10g}")
+        for k in sorted(self.histograms):
+            name = PREFIX + k
+            if k in _HIST_HELP:
+                lines.append(f"# HELP {name} {_HIST_HELP[k]}")
+            lines.append(f"# TYPE {name} histogram")
+            h = self.histograms[k]
+            cum = 0
+            for le, n in zip(LATENCY_BUCKETS, h["counts"]):
+                cum += n
+                lines.append(f'{name}_bucket{{le="{le:.10g}"}} {cum}')
+            cum += h["counts"][-1]
+            lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f'{name}_sum {h["sum"]:.10g}')
+            lines.append(f'{name}_count {h["count"]}')
         return "\n".join(lines) + "\n"
 
     def write(self) -> None:
@@ -175,6 +239,8 @@ class MetricsExporter:
         _replace(self.path, self.render())
         snap = {"ts": time.time(), "writes": self._writes,
                 "meta": self.meta, "gauges": self.finite_values()}
+        if self.histograms:
+            snap["histograms"] = self.histograms
         _replace(self.json_path, json.dumps(snap, indent=1) + "\n")
 
 
@@ -224,6 +290,52 @@ def read_textfile(path: str) -> Dict[str, float]:
             if not name.startswith(PREFIX):
                 raise ValueError(f"unexpected metric name: {name!r}")
             out[name[len(PREFIX):]] = float(value)
+    return out
+
+
+def read_histogram(path: str) -> Dict[str, Dict]:
+    """Parse the histogram series of a textfile back into
+    ``{bare_name: {"buckets": [(le, cumulative_count), ...],
+    "sum": float, "count": int}}`` with ``le`` floats (``inf`` for the
+    +Inf bucket) — the verification half of
+    :meth:`MetricsExporter.observe`.  Raises ValueError when a
+    histogram's buckets are not monotone non-decreasing or its +Inf
+    bucket disagrees with ``_count``."""
+    out: Dict[str, Dict] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "_bucket{le=" in line:
+                name_part, _, rest = line.partition("_bucket{le=\"")
+                le_str, _, val = rest.partition("\"}")
+                bare = name_part[len(PREFIX):]
+                le = float("inf") if le_str == "+Inf" else float(le_str)
+                out.setdefault(bare, {"buckets": [], "sum": 0.0,
+                                      "count": 0})
+                out[bare]["buckets"].append((le, int(float(val))))
+            elif "{" not in line:
+                name, _, val = line.partition(" ")
+                if name.endswith("_sum") and \
+                        name[len(PREFIX):-len("_sum")] in out:
+                    out[name[len(PREFIX):-len("_sum")]]["sum"] = \
+                        float(val)
+                elif name.endswith("_count") and \
+                        name[len(PREFIX):-len("_count")] in out:
+                    out[name[len(PREFIX):-len("_count")]]["count"] = \
+                        int(float(val))
+    for bare, h in out.items():
+        counts = [n for _le, n in h["buckets"]]
+        if any(b > a for a, b in zip(counts[1:], counts)):
+            raise ValueError(
+                f"histogram {bare!r} buckets not monotone: {counts}")
+        if h["buckets"] and not math.isinf(h["buckets"][-1][0]):
+            raise ValueError(f"histogram {bare!r} missing +Inf bucket")
+        if h["buckets"] and counts[-1] != h["count"]:
+            raise ValueError(
+                f"histogram {bare!r}: +Inf bucket {counts[-1]} != "
+                f"_count {h['count']}")
     return out
 
 

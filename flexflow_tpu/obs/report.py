@@ -444,6 +444,20 @@ def _serve_section(events: List[Dict]) -> List[str]:
             f"{_fmt_s(pct(50))} / p90 {_fmt_s(pct(90))} / p99 "
             f"{_fmt_s(pct(99))} (min {_fmt_s(lat[0])}, max "
             f"{_fmt_s(lat[-1])})")
+        ttft = sorted(float(e["ttft_s"]) for e in reqs
+                      if e.get("ttft_s") is not None)
+        tpot = sorted(float(e["tpot_s"]) for e in reqs
+                      if e.get("tpot_s") is not None)
+        if ttft:
+            def tpct(vals, q):
+                return vals[min(int(q / 100.0 * len(vals)),
+                                len(vals) - 1)]
+            line = (f"  ttft: p50 {_fmt_s(tpct(ttft, 50))} / p99 "
+                    f"{_fmt_s(tpct(ttft, 99))}")
+            if tpot:
+                line += (f", tpot: p50 {_fmt_s(tpct(tpot, 50))} / p99 "
+                         f"{_fmt_s(tpct(tpot, 99))}")
+            lines.append(line)
         lines.append("  latency histogram (virtual seconds):")
         lines.extend(_latency_histogram(lat))
     if batches:
@@ -464,15 +478,54 @@ def _serve_section(events: List[Dict]) -> List[str]:
             f"{_fmt_s(r.get('research_s', 0.0))} "
             f"[{research.get('mode', '?')}])")
     for s in summaries:
+        ttft_part = ""
+        if s.get("ttft_p50_s") is not None:
+            ttft_part = (f", ttft p50 {_fmt_s(s.get('ttft_p50_s', 0.0))}"
+                         f", tpot p50 {_fmt_s(s.get('tpot_p50_s') or 0.0)}")
         lines.append(
             f"  summary: {s.get('completed', 0)}/{s.get('requests', 0)} "
             f"served ({s.get('unserved', 0)} unserved, "
             f"{s.get('dropped', 0)} dropped), qps "
             f"{s.get('qps', 0.0):.1f}, p50 {_fmt_s(s.get('p50_s', 0.0))},"
-            f" p99 {_fmt_s(s.get('p99_s', 0.0))}, "
+            f" p99 {_fmt_s(s.get('p99_s', 0.0))}{ttft_part}, "
             f"{s.get('resizes', 0)} resize(s), "
             f"{s.get('devices', '?')} devices"
             + (", drained" if s.get("drained") else ""))
+    return lines
+
+
+def _slo_section(events: List[Dict]) -> List[str]:
+    """The SLO / load-harness records: per-spec burn-rate verdicts
+    (``slo``) and sustained-load sweep points (``loadtest``)."""
+    slos = [e for e in events if e.get("kind") == "slo"]
+    points = [e for e in events if e.get("kind") == "loadtest"]
+    if not (slos or points):
+        return []
+    lines = ["== slo / loadtest =="]
+    for s in slos:
+        spec = s.get("spec") or {}
+        ach = s.get("achieved_percentile_s")
+        lines.append(
+            f"  slo[{spec.get('name', '?')}]: p{spec.get('percentile')} "
+            f"<= {_fmt_s(spec.get('latency_target_s') or 0.0)} @ "
+            f"{spec.get('availability')} -> "
+            f"{'COMPLIANT' if s.get('compliant') else 'VIOLATED'} "
+            f"(achieved {_fmt_s(ach) if ach is not None else '?'}, "
+            f"burn {s.get('burn_rate', 0.0):.2f}x, worst window "
+            f"{s.get('max_window_burn_rate', 0.0):.2f}x over "
+            f"{s.get('windows', 0)} window(s), goodput "
+            f"{s.get('goodput_qps', 0.0):.1f} qps)")
+    for p in points:
+        lines.append(
+            f"  loadtest[{p.get('pattern', '?')}] {p.get('devices', '?')}"
+            f" device(s): {p.get('completed', '?')}/"
+            f"{p.get('requests', '?')} served, qps "
+            f"{p.get('qps', 0.0):.1f} (offered "
+            f"{p.get('offered_qps', 0.0):.1f}), p50 "
+            f"{_fmt_s(p.get('p50_s') or 0.0)}, p99 "
+            f"{_fmt_s(p.get('p99_s') or 0.0)}, ttft p50 "
+            f"{_fmt_s(p.get('ttft_p50_s') or 0.0)}, goodput "
+            f"{p.get('goodput_qps', 0.0):.1f} qps")
     return lines
 
 
@@ -639,7 +692,8 @@ def render(events: Iterable[Dict]) -> str:
         return "(empty run log)"
     sections = [_header(events), _fit_section(events),
                 _fault_section(events), _elastic_section(events),
-                _serve_section(events), _fleet_section(events),
+                _serve_section(events), _slo_section(events),
+                _fleet_section(events),
                 _search_section(events),
                 _audit_bench_section(events), _lint_section(events),
                 _trace_section(events), _misc_section(events)]
@@ -883,6 +937,16 @@ def summarize(events: Iterable[Dict]) -> Dict:
                 "p50": lat[min(len(lat) // 2, len(lat) - 1)],
                 "p99": lat[min(int(0.99 * len(lat)), len(lat) - 1)],
                 "min": lat[0], "max": lat[-1], "n": len(lat)}
+        for key, field in (("ttft_s", "ttft_s"), ("tpot_s", "tpot_s")):
+            vals = sorted(float(e[field]) for e in events
+                          if e.get("kind") == "serve_request"
+                          and e.get(field) is not None)
+            if vals:
+                sv[key] = {
+                    "p50": vals[min(len(vals) // 2, len(vals) - 1)],
+                    "p99": vals[min(int(0.99 * len(vals)),
+                                    len(vals) - 1)],
+                    "n": len(vals)}
         srs = [e for e in events if e.get("kind") == "serve_resize"]
         if srs:
             sv["resizes"] = [
@@ -898,10 +962,25 @@ def summarize(events: Iterable[Dict]) -> Dict:
             s = sums[-1]
             sv["summary"] = {k: s.get(k) for k in
                              ("requests", "completed", "unserved",
-                              "dropped", "qps", "p50_s", "p99_s", "steps",
+                              "dropped", "qps", "p50_s", "p99_s",
+                              "ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
+                              "tpot_p99_s", "steps",
                               "resizes", "virtual_s", "drained",
                               "devices")}
         out["serve"] = sv
+    slos = [e for e in events if e.get("kind") == "slo"]
+    if slos:
+        out["slo"] = [{k: s.get(k) for k in
+                       ("spec", "total", "good", "violations",
+                        "error_rate", "error_budget", "burn_rate",
+                        "max_window_burn_rate", "windows",
+                        "achieved_percentile_s", "compliant",
+                        "goodput_qps")} for s in slos]
+    points = [e for e in events if e.get("kind") == "loadtest"]
+    if points:
+        out["loadtest"] = [{k: v for k, v in p.items()
+                            if k not in ("run", "ts", "kind", "surface")}
+                           for p in points]
     fleet_kinds = ("fleet_job", "fleet_placement", "fleet_rebalance",
                    "fleet_summary")
     if any(kinds.get(k) for k in fleet_kinds):

@@ -45,6 +45,8 @@ _US = 1e6  # trace_event timestamps/durations are microseconds
 PID_SIM_BEST = 0
 PID_SIM_DP = 1
 PID_REAL = 2
+PID_SERVE = 3
+PID_FLEET = 4
 
 
 def meta_event(pid: int, name: str, tid: Optional[int] = None) -> Dict:
@@ -279,6 +281,167 @@ def validate_trace(trace: Any) -> List[str]:
                     f"pid={pid} tid={tid} (start {ts} < prev end {end})")
             end = max(end, ts + dur)
     return errors
+
+
+# ---------------------------------------------------------------------------
+# serving lanes: per-request lifecycle + engine counters
+
+
+def serve_trace_events(records: Iterable[Dict], pid: int = PID_SERVE,
+                       label: str = "serve") -> List[Dict]:
+    """Chrome events for one serve-engine run, from its
+    ``serve_request`` / ``serve_batch`` obs records (virtual-clock
+    timestamps, so the trace is bit-identical under a fixed seed).
+
+    Lanes:
+
+      * one thread per request (``req <rid>``): a ``queue`` span from
+        arrival to admission, then a ``decode`` span from admission to
+        completion carrying TTFT/TPOT/latency in ``args``.  Request
+        cats are NOT ``compute`` — concurrent requests legitimately
+        overlap across lanes and within a continuous batch;
+      * admission flow arrows (``ph: "s"``/``"f"``): requests admitted
+        at the same virtual instant are one continuous-batching
+        admission group — the arrow runs from the group's first
+        request lane to each other member;
+      * counter lanes from ``serve_batch``: queue depth, active/
+        admitted slots, and KV-cache occupancy (tokens + fraction of
+        the ``max_batch x max_seq`` rectangle) over virtual time.
+
+    Timestamps are shifted so the earliest arrival lands at 0 (trace
+    viewers and :func:`validate_trace` want non-negative ts)."""
+    records = list(records)
+    reqs = [r for r in records if r.get("kind") == "serve_request"]
+    batches = [r for r in records if r.get("kind") == "serve_batch"]
+    events = [meta_event(pid, label)]
+    if not reqs and not batches:
+        return events
+    t0 = min([float(r["arrival_v"]) for r in reqs
+              if r.get("arrival_v") is not None]
+             + [float(b["vnow"]) for b in batches
+                if b.get("vnow") is not None] + [0.0])
+
+    def ts(v: float) -> float:
+        return (float(v) - t0) * _US
+
+    tids: Dict[Any, int] = {}
+    for r in reqs:
+        rid = r.get("rid")
+        if rid not in tids:
+            tids[rid] = 10 + len(tids)
+            events.append(meta_event(pid, f"req {rid}", tids[rid]))
+        tid = tids[rid]
+        arrival = r.get("arrival_v")
+        admit = r.get("admit_v")
+        done = r.get("done_v")
+        if arrival is not None and admit is not None:
+            events.append({
+                "name": f"queue {rid}", "cat": "queue", "ph": "X",
+                "ts": ts(arrival),
+                "dur": max(0.0, (float(admit) - float(arrival)) * _US),
+                "pid": pid, "tid": tid,
+                "args": {"rid": rid,
+                         "queue_wait_s": float(admit) - float(arrival)}})
+        if admit is not None and done is not None:
+            events.append({
+                "name": f"decode {rid}", "cat": "decode", "ph": "X",
+                "ts": ts(admit),
+                "dur": max(0.0, (float(done) - float(admit)) * _US),
+                "pid": pid, "tid": tid,
+                "args": {"rid": rid, "latency_s": r.get("latency_s"),
+                         "ttft_s": r.get("ttft_s"),
+                         "tpot_s": r.get("tpot_s"),
+                         "prompt_len": r.get("prompt_len"),
+                         "new_tokens": r.get("new_tokens")}})
+    # admission groups -> flow arrows between member lanes
+    groups: Dict[float, List[Dict]] = {}
+    for r in reqs:
+        if r.get("admit_v") is not None:
+            groups.setdefault(float(r["admit_v"]), []).append(r)
+    for flow_id, admit in enumerate(sorted(groups)):
+        members = groups[admit]
+        if len(members) < 2:
+            continue  # a single admission needs no arrow
+        head, rest = members[0], members[1:]
+        events.append({"name": "admit", "cat": "admission", "ph": "s",
+                       "id": flow_id, "ts": ts(admit), "pid": pid,
+                       "tid": tids[head.get("rid")],
+                       "args": {"batch": len(members)}})
+        for m in rest:
+            events.append({"name": "admit", "cat": "admission",
+                           "ph": "f", "bp": "e", "id": flow_id,
+                           "ts": ts(admit), "pid": pid,
+                           "tid": tids[m.get("rid")],
+                           "args": {"batch": len(members)}})
+    for b in batches:
+        vnow = b.get("vnow")
+        if vnow is None:
+            continue
+        bts = ts(vnow)
+        if isinstance(b.get("queue_depth"), (int, float)):
+            events.append({"name": "queue depth", "ph": "C", "pid": pid,
+                           "tid": 0, "ts": bts,
+                           "args": {"queued": float(b["queue_depth"])}})
+        slots = {k: float(b[k]) for k in ("active", "admitted")
+                 if isinstance(b.get(k), (int, float))}
+        if slots:
+            events.append({"name": "slots", "ph": "C", "pid": pid,
+                           "tid": 0, "ts": bts, "args": slots})
+        kv = {k: float(b[k]) for k in ("kv_tokens", "kv_frac")
+              if isinstance(b.get(k), (int, float))}
+        if kv:
+            events.append({"name": "KV cache", "ph": "C", "pid": pid,
+                           "tid": 0, "ts": bts, "args": kv})
+    return events
+
+
+def fleet_trace_events(records: Iterable[Dict],
+                       pid: int = PID_FLEET,
+                       label: str = "fleet") -> List[Dict]:
+    """Per-job device-occupancy counter lanes from a fleet
+    coordinator's ``fleet_job`` / ``fleet_rebalance`` obs records.
+
+    Each job gets one counter track (``job <name> devices``) sampled
+    wherever its assignment is visible: ``fleet_job`` records carrying
+    a ``devices`` field (admission, resize, completion — completion
+    and eviction drop the track to 0) and ``fleet_rebalance`` moves
+    (the post-move ``to`` ordinal list length).  The time axis is the
+    records' wall-clock ``ts``, shifted so the stream starts at 0 —
+    fleet scheduling has no virtual clock, relative order is what the
+    lanes show."""
+    records = list(records)
+    samples: List[tuple] = []  # (wall_ts, job, devices)
+    for r in records:
+        kind = r.get("kind")
+        wall = r.get("ts")
+        if not isinstance(wall, (int, float)):
+            continue
+        if kind == "fleet_job":
+            job = r.get("job")
+            devices = r.get("devices")
+            if job is None:
+                continue
+            if r.get("state") in ("done", "failed", "evicted"):
+                samples.append((float(wall), str(job), 0.0))
+            elif isinstance(devices, (int, float)):
+                samples.append((float(wall), str(job), float(devices)))
+        elif kind == "fleet_rebalance":
+            for mv in r.get("moves", []) or []:
+                job = mv.get("job")
+                to = mv.get("to")
+                if job is not None and isinstance(to, list):
+                    samples.append((float(wall), str(job),
+                                    float(len(to))))
+    events = [meta_event(pid, label)]
+    if not samples:
+        return events
+    t0 = min(s[0] for s in samples)
+    for wall, job, devices in sorted(samples):
+        events.append({"name": f"job {job} devices", "ph": "C",
+                       "pid": pid, "tid": 0,
+                       "ts": (wall - t0) * _US,
+                       "args": {"devices": devices}})
+    return events
 
 
 # ---------------------------------------------------------------------------

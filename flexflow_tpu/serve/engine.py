@@ -34,11 +34,25 @@ SIGTERM flag (utils/elastic.install_drain_handler) stops admission, the
 in-flight slots finish and the engine returns cleanly — never-admitted
 requests are reported as ``unserved``, not dropped.
 
-Obs records: ``serve_request`` (one per completed request),
-``serve_batch`` (one per decode step / forward batch), ``serve_resize``
-(one per autoscale event), ``serve_summary`` (one per run).  Prometheus
-gauges: ``ff_qps``, ``ff_queue_depth``, ``ff_latency_p50_s``,
-``ff_latency_p99_s``, ``ff_requests_total``.
+**Per-request tracing**: the engine stamps ``first_token_v`` on each
+request at the decode boundary its first generated token lands, so
+every ``serve_request`` record (and the run summary) carries the
+TTFT/TPOT split alongside total latency — TTFT (arrival -> first token)
+is what an interactive user feels, TPOT (the decode tail per remaining
+token) is what the decode loop costs.  ``serve_batch`` records carry
+the KV-cache occupancy (``kv_tokens``/``kv_frac``) next to queue depth
+and active slots, which ``obs/trace.py::serve_trace_events`` renders as
+Perfetto counter lanes.
+
+Obs records: ``serve_request`` (one per completed request, with
+``ttft_s``/``tpot_s``), ``serve_batch`` (one per decode step / forward
+batch, with KV occupancy), ``serve_resize`` (one per autoscale event),
+``serve_summary`` (one per run, with TTFT/TPOT percentiles).
+Prometheus gauges: ``ff_qps``, ``ff_queue_depth``, ``ff_latency_p50_s``,
+``ff_latency_p99_s``, ``ff_ttft_p50_s``, ``ff_ttft_p99_s``,
+``ff_tpot_p50_s``, ``ff_requests_total``, plus the
+``ff_request_latency_s`` / ``ff_request_ttft_s`` histograms
+(fixed log-spaced buckets, obs/metrics.py).
 """
 
 from __future__ import annotations
@@ -271,27 +285,36 @@ class ServeEngine:
         logprobs = np.asarray(outs[0])
         step_wall = time.perf_counter() - t0
         self._fill_kv(outs[1:], active, pre_lengths)
+        done_v = vnow + self.step_time_s  # this step's tokens land here
         for slot_idx, slot in active:
             nxt_tok = int(np.argmax(logprobs[slot_idx,
                                              slot.length - 1]))
             slot.req.wall_s += step_wall
             batcher.record_token(slot_idx, nxt_tok)
-        s["vnow"] = vnow = vnow + self.step_time_s
+            if slot.generated == 1:
+                # the request's FIRST token materialized this step —
+                # the TTFT stamp every serve_request record carries
+                slot.req.first_token_v = done_v
+        s["vnow"] = vnow = done_v
         s["steps"] += 1
         for slot_idx, req in batcher.reclaim(vnow):
             if self.kv_cache is not None:
                 self.kv_cache.reclaim(slot_idx)
             self._kv_filled[slot_idx] = 0
             s["completed"].append(req)
+            self._observe_request(req)
             self.olog.event(
                 "serve_request", rid=req.rid, arrival_v=req.arrival_v,
-                admit_v=req.admit_v, done_v=req.done_v,
-                latency_s=req.latency_s, prompt_len=len(req.tokens),
+                admit_v=req.admit_v, first_token_v=req.first_token_v,
+                done_v=req.done_v, latency_s=req.latency_s,
+                ttft_s=req.ttft_s, tpot_s=req.tpot_s,
+                prompt_len=len(req.tokens),
                 new_tokens=len(req.reply or ()), wall_s=req.wall_s)
         self.olog.event("serve_batch", step=s["steps"], vnow=vnow,
                         active=len(active), admitted=len(admitted),
                         queue_depth=depth,
-                        devices=self.model.machine.num_devices)
+                        devices=self.model.machine.num_devices,
+                        **self._kv_occupancy())
         self._update_gauges(s["completed"], depth, vnow)
         return True
 
@@ -308,6 +331,30 @@ class ServeEngine:
                                s["steps"],
                                time.perf_counter() - s["t_wall0"],
                                drained=s["draining"])
+
+    def _kv_occupancy(self) -> Dict:
+        """KV-cache occupancy of the live batch rectangle: filled token
+        positions (host view of the ring fill) and the fraction of the
+        cache's ``(max_batch, max_seq)`` capacity they use — the counter
+        lane ``serve_trace_events`` renders."""
+        if self.kv_layout is None:
+            return {"kv_tokens": 0, "kv_frac": 0.0}
+        ms = self.kv_layout.max_seq
+        toks = sum(min(n, ms) for n in self._kv_filled)
+        cap = self.max_batch * ms
+        return {"kv_tokens": int(toks),
+                "kv_frac": (toks / cap) if cap else 0.0}
+
+    def _observe_request(self, req: Request) -> None:
+        """Feed one completed request into the latency/TTFT histograms
+        (fixed log-spaced buckets, obs/metrics.py) — the per-request
+        half of the scrape, aggregatable across replicas."""
+        if self.metrics is None:
+            return
+        if req.latency_s is not None:
+            self.metrics.observe("request_latency_s", req.latency_s)
+        if req.ttft_s is not None:
+            self.metrics.observe("request_ttft_s", req.ttft_s)
 
     def _fill_kv(self, attn_ins, active, pre_lengths) -> None:
         """Project this step's NEW positions into the KV cache from the
@@ -380,21 +427,28 @@ class ServeEngine:
                 batches += 1
                 for i, req in enumerate(members):
                     req.admit_v = vstart
+                    # a forward-only reply IS the first (and only)
+                    # "token": TTFT == total latency, no decode tail
+                    req.first_token_v = vnow
                     req.done_v = vnow
                     req.wall_s = wall
                     req.reply = out[i]
                     completed.append(req)
+                    self._observe_request(req)
                     self.olog.event(
                         "serve_request", rid=req.rid,
                         arrival_v=req.arrival_v, admit_v=req.admit_v,
+                        first_token_v=req.first_token_v,
                         done_v=req.done_v, latency_s=req.latency_s,
+                        ttft_s=req.ttft_s, tpot_s=req.tpot_s,
                         prompt_len=int(np.asarray(req.tokens).shape[0])
                         if np.asarray(req.tokens).ndim else 0,
                         new_tokens=0, wall_s=wall)
                 self.olog.event("serve_batch", step=batches, vnow=vnow,
                                 active=len(members), admitted=len(members),
                                 queue_depth=0,
-                                devices=model.machine.num_devices)
+                                devices=model.machine.num_devices,
+                                kv_tokens=0, kv_frac=0.0)
         return self._summarize(completed, unserved, vnow, batches,
                                time.perf_counter() - t_wall0,
                                drained=bool(unserved))
@@ -491,17 +545,24 @@ class ServeEngine:
         if self.metrics is None:
             return
         lat = [r.latency_s for r in completed if r.latency_s is not None]
+        ttft = [r.ttft_s for r in completed if r.ttft_s is not None]
+        tpot = [r.tpot_s for r in completed if r.tpot_s is not None]
         self.metrics.update(
             qps=(len(completed) / vnow) if vnow > 0 else 0.0,
             queue_depth=depth,
             latency_p50_s=_percentile(lat, 50) if lat else None,
             latency_p99_s=_percentile(lat, 99) if lat else None,
+            ttft_p50_s=_percentile(ttft, 50) if ttft else None,
+            ttft_p99_s=_percentile(ttft, 99) if ttft else None,
+            tpot_p50_s=_percentile(tpot, 50) if tpot else None,
             requests_total=len(completed))
         self.metrics.write()
 
     def _summarize(self, completed, unserved, vnow, steps, wall_s,
                    drained=False) -> Dict:
         lat = [r.latency_s for r in completed if r.latency_s is not None]
+        ttft = [r.ttft_s for r in completed if r.ttft_s is not None]
+        tpot = [r.tpot_s for r in completed if r.tpot_s is not None]
         summary = {
             "requests": len(completed) + len(unserved),
             "completed": len(completed),
@@ -510,6 +571,10 @@ class ServeEngine:
             "qps": (len(completed) / vnow) if vnow > 0 else 0.0,
             "p50_s": _percentile(lat, 50),
             "p99_s": _percentile(lat, 99),
+            "ttft_p50_s": _percentile(ttft, 50),
+            "ttft_p99_s": _percentile(ttft, 99),
+            "tpot_p50_s": _percentile(tpot, 50),
+            "tpot_p99_s": _percentile(tpot, 99),
             "steps": steps,
             "resizes": len(self.resizes),
             "virtual_s": vnow,

@@ -1,0 +1,176 @@
+"""Sustained-load harness (apps/loadtest.py) and the composable arrival
+patterns behind it (serve/loadgen.py patterned_requests): seeded
+determinism per pattern and composition, parameter validation,
+heavy-tail prompt-length bounds, flag parsing, artifact rounding, and
+the ``loadtest`` obs record through report's summarize/render."""
+
+import math
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.serve.loadgen import (ARRIVAL_PATTERNS, MIN_PROMPT_ID,
+                                        patterned_requests,
+                                        synthetic_requests)
+
+
+# ---------------------------------------------------------------------------
+# arrival patterns
+
+
+@pytest.mark.parametrize("pattern", list(ARRIVAL_PATTERNS)
+                         + ["diurnal+bursty",
+                            "heavy_tail+diurnal+bursty"])
+def test_patterned_requests_deterministic(pattern):
+    a = patterned_requests(24, seed=11, rate_qps=50.0, pattern=pattern)
+    b = patterned_requests(24, seed=11, rate_qps=50.0, pattern=pattern)
+    assert [r.arrival_v for r in a] == [r.arrival_v for r in b]
+    assert all((x.tokens == y.tokens).all() for x, y in zip(a, b))
+    assert all(a[i].arrival_v <= a[i + 1].arrival_v for i in range(23))
+    assert all((r.tokens >= MIN_PROMPT_ID).all() for r in a)
+    # a different seed moves the arrivals
+    c = patterned_requests(24, seed=12, rate_qps=50.0, pattern=pattern)
+    assert [r.arrival_v for r in a] != [r.arrival_v for r in c]
+
+
+def test_patterned_poisson_matches_synthetic():
+    """With no modulators the patterned stream is the plain Poisson
+    process — same draw order as synthetic_requests."""
+    a = patterned_requests(10, seed=3, rate_qps=100.0, pattern="poisson")
+    b = synthetic_requests(10, seed=3, rate_qps=100.0)
+    assert [r.arrival_v for r in a] == [r.arrival_v for r in b]
+
+
+def test_bursty_pattern_clusters_arrivals():
+    """Arrivals concentrate in the on-windows: with a strong burst
+    factor, most arrivals land inside the on phase of each cycle."""
+    reqs = patterned_requests(200, seed=0, rate_qps=20.0,
+                              pattern="bursty", burst_on_s=1.0,
+                              burst_off_s=9.0, burst_factor=50.0)
+    in_burst = sum(1 for r in reqs if (r.arrival_v % 10.0) < 1.0)
+    assert in_burst / len(reqs) > 0.7
+
+
+def test_heavy_tail_prompt_lengths_bounded():
+    reqs = patterned_requests(64, seed=5, rate_qps=50.0,
+                              pattern="heavy_tail", prompt_len=4,
+                              max_prompt_len=12, vocab_size=64)
+    lens = [len(r.tokens) for r in reqs]
+    assert min(lens) >= 4 and max(lens) <= 12
+    assert len(set(lens)) > 1  # the tail actually varies lengths
+    assert all(int(r.tokens.max()) < 64 and
+               int(r.tokens.min()) >= MIN_PROMPT_ID for r in reqs)
+
+
+def test_patterned_requests_validation():
+    with pytest.raises(ValueError):
+        patterned_requests(4, pattern="fractal")
+    with pytest.raises(ValueError):
+        patterned_requests(-1)
+    with pytest.raises(ValueError):
+        patterned_requests(4, rate_qps=0.0)
+    with pytest.raises(ValueError):
+        patterned_requests(4, pattern="heavy_tail", tail_alpha=1.0)
+    with pytest.raises(ValueError):
+        patterned_requests(4, pattern="diurnal", diurnal_amp=1.5)
+    with pytest.raises(ValueError):
+        patterned_requests(4, pattern="bursty", burst_factor=0.5)
+    with pytest.raises(ValueError):
+        # pad/EOS leave no room for prompt ids
+        patterned_requests(4, vocab_size=2)
+
+
+def test_request_ttft_tpot_properties():
+    from flexflow_tpu.serve.loadgen import Request
+
+    r = Request(rid=0, arrival_v=1.0,
+                tokens=np.array([2, 3], dtype=np.int32),
+                max_new_tokens=3)
+    assert r.ttft_s is None and r.tpot_s is None
+    r.admit_v = 1.5
+    r.first_token_v = 1.6
+    r.reply = [4, 5, 6]
+    r.done_v = 1.8
+    assert r.ttft_s == pytest.approx(0.6)
+    assert r.tpot_s == pytest.approx((1.8 - 1.6) / 2)
+    # single-token reply: no decode tail, TPOT defined as 0.0
+    r.reply = [4]
+    assert r.tpot_s == 0.0
+
+
+# ---------------------------------------------------------------------------
+# harness plumbing (no engine run — make loadtest-smoke covers e2e)
+
+
+def test_loadtest_parse_args_and_round():
+    from flexflow_tpu.apps.loadtest import _round, parse_args
+
+    opts = parse_args([])
+    assert opts["devices"] == "2,4,8" and opts["requests"] == 60
+    assert opts["pattern"] == "diurnal+bursty"
+    opts = parse_args(["--smoke", "--pattern", "heavy_tail",
+                       "--devices", "4,8", "--rate-qps", "33",
+                       "--slo-target-s", "0.5", "--seed", "7"])
+    assert opts["smoke"] and opts["requests"] == 18  # smoke caps n
+    assert opts["pattern"] == "heavy_tail"
+    assert opts["devices"] == "4,8" and opts["rate_qps"] == 33.0
+    assert opts["slo_target_s"] == 0.5 and opts["seed"] == 7
+    assert _round(None) is None
+    assert _round(0.123456789) == 0.123457
+    assert _round(5) == 5
+    assert math.isinf(_round(float("inf")))
+
+
+def test_loadtest_record_through_report(tmp_path):
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs.report import render, summarize
+
+    point = {"pattern": "diurnal+bursty", "rate_qps": 80.0, "seed": 0,
+             "devices": 8, "slots": 16, "requests": 60, "completed": 60,
+             "unserved": 0, "qps": 350.0, "offered_qps": 90.0,
+             "p50_s": 0.02, "p99_s": 0.05, "ttft_p50_s": 0.017,
+             "ttft_p99_s": 0.03, "tpot_p50_s": 0.01, "tpot_p99_s": 0.01,
+             "goodput_qps": 340.0, "slo_burn_rate": 0.0,
+             "slo_max_window_burn_rate": 0.0, "slo_compliant": True,
+             "steps": 40, "virtual_s": 0.8}
+    olog = obs.RunLog(str(tmp_path / "lt.jsonl"), surface="loadtest")
+    olog.event("loadtest", **point)
+    olog.close()
+    events = list(obs.read_run(olog.path))
+    text = render(events)
+    assert "loadtest[diurnal+bursty]" in text
+    assert "8 device(s)" in text
+    out = summarize(events)
+    assert out["loadtest"][0]["devices"] == 8
+    assert out["loadtest"][0]["goodput_qps"] == pytest.approx(340.0)
+    assert "ts" not in out["loadtest"][0]
+
+
+def test_serve_bench_artifact_schema():
+    """The committed SERVE_r01.json keeps the serve_bench_v1 contract:
+    metric line under "parsed", >= 3 finite sweep points, monotone
+    goodput across the device sweep."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SERVE_r01.json")
+    if not os.path.exists(path):
+        pytest.skip("SERVE_r01.json not committed yet")
+    with open(path) as f:
+        art = json.load(f)
+    assert art["schema"] == "serve_bench_v1"
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(
+        art["parsed"])
+    assert art["parsed"]["unit"] == "req/s"
+    sweep = art["sweep"]
+    assert len(sweep) >= 3
+    for p in sweep:
+        for k in ("qps", "p50_s", "p99_s", "ttft_p50_s", "tpot_p50_s",
+                  "goodput_qps", "slo_burn_rate"):
+            assert math.isfinite(p[k]), (p["devices"], k)
+        assert p["completed"] == p["requests"]
+    devs = [p["devices"] for p in sweep]
+    assert devs == sorted(devs)
+    goodput = [p["goodput_qps"] for p in sweep]
+    assert goodput[-1] > goodput[0]  # more devices -> more goodput

@@ -308,6 +308,46 @@ def test_engine_serves_all_and_emits_records(tiny_lm, tmp_path):
     assert gauges["qps"] > 0 and math.isfinite(gauges["latency_p99_s"])
 
 
+def test_engine_stamps_ttft_and_tpot(tiny_lm, tmp_path):
+    """TTFT/TPOT attribution: the engine stamps first_token_v at the
+    decode boundary that materializes each request's first token, the
+    serve_request records and summary carry the split, and serve_batch
+    reports KV occupancy."""
+    from flexflow_tpu import obs
+    from flexflow_tpu.serve.engine import ServeEngine
+
+    model, _ = tiny_lm
+    olog = obs.RunLog(str(tmp_path / "ttft.jsonl"), surface="serve")
+    eng = ServeEngine(model, None, olog=olog, log=lambda *a: None)
+    reqs = synthetic_requests(10, seed=3, rate_qps=300.0, vocab_size=64,
+                              prompt_len=4, max_new_tokens=3)
+    summary = eng.run(reqs)
+    olog.close()
+    for r in reqs:
+        assert r.first_token_v is not None
+        # first token lands at the END of a decode step, strictly after
+        # admission, never after completion
+        assert r.admit_v < r.first_token_v <= r.done_v
+        assert 0 < r.ttft_s <= r.latency_s
+        assert r.tpot_s is not None and r.tpot_s >= 0
+        if len(r.reply) > 1:
+            # virtual decode cadence: one step per token
+            assert r.tpot_s == pytest.approx(eng.step_time_s)
+    for k in ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s"):
+        assert math.isfinite(summary[k])
+    assert summary["ttft_p50_s"] <= summary["p50_s"]
+    events = list(obs.read_run(olog.path))
+    rrecs = [e for e in events if e["kind"] == "serve_request"]
+    assert rrecs and all(
+        math.isfinite(e["ttft_s"]) and math.isfinite(e["tpot_s"])
+        and e["first_token_v"] is not None for e in rrecs)
+    brecs = [e for e in events if e["kind"] == "serve_batch"]
+    assert brecs
+    assert all("kv_tokens" in e and "kv_frac" in e for e in brecs)
+    assert any(e["kv_tokens"] > 0 for e in brecs)
+    assert all(0.0 <= e["kv_frac"] <= 1.0 for e in brecs)
+
+
 def test_summarize_tolerates_stepless_serving_run():
     """A pure serving stream has no `step` records — summarize must not
     require them (satellite: obs tolerant of training-free runs)."""

@@ -1,0 +1,239 @@
+"""SLO burn-rate monitoring (obs/slo.py) and the Prometheus histogram
+export (obs/metrics.py): spec validation, whole-stream and rolling-
+window burn-rate math (including empty and degenerate streams), gauge
+export, the ``slo`` obs record, fixed log-spaced latency buckets, and
+the textfile round-trip (``read_textfile`` must survive histogram
+lines; ``read_histogram`` must reject non-monotone buckets)."""
+
+import math
+
+import pytest
+
+from flexflow_tpu.obs.slo import (SLOSpec, burn_rate_windows, evaluate,
+                                  export_gauges, log_record)
+
+
+def _reqs(latencies, spacing=0.1, t0=1.0):
+    """A serve_request stream with one completion per ``spacing``
+    virtual seconds."""
+    return [{"kind": "serve_request", "done_v": t0 + i * spacing,
+             "latency_s": lat} for i, lat in enumerate(latencies)]
+
+
+# ---------------------------------------------------------------------------
+# spec
+
+
+def test_slo_spec_validation_and_round_trip():
+    s = SLOSpec(name="web", latency_target_s=0.2, percentile=95.0,
+                availability=0.99, window_s=10.0)
+    assert abs(s.error_budget - 0.01) < 1e-12
+    assert SLOSpec.from_dict(s.to_dict()) == s
+    # unknown keys are dropped, not fatal (records carry extra fields)
+    assert SLOSpec.from_dict(dict(s.to_dict(), devices=8)) == s
+    for bad in (dict(latency_target_s=0.0),
+                dict(latency_target_s=-1.0),
+                dict(percentile=0.0), dict(percentile=101.0),
+                dict(availability=0.0), dict(availability=1.0),
+                dict(window_s=0.0)):
+        with pytest.raises(ValueError):
+            SLOSpec(**bad)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math
+
+
+def test_burn_rate_whole_stream():
+    # 2 of 10 requests miss a 0.1s target; availability 0.9 -> budget
+    # 0.1 -> burn = 0.2 / 0.1 = 2x
+    spec = SLOSpec(latency_target_s=0.1, availability=0.9, window_s=5.0)
+    res = evaluate(_reqs([0.05] * 8 + [0.5, 0.9]), spec)
+    assert res["total"] == 10 and res["violations"] == 2
+    assert abs(res["error_rate"] - 0.2) < 1e-12
+    assert abs(res["burn_rate"] - 2.0) < 1e-9
+    assert res["good"] == 8
+    # goodput: 8 good completions over the 0.9s completion span... the
+    # span here is max(done_v) = 1.9 (absolute virtual clock)
+    assert res["goodput_qps"] > 0
+    assert not res["compliant"]  # p99 is ~0.9s > 0.1s
+
+
+def test_burn_rate_windows_tile_the_span():
+    spec = SLOSpec(latency_target_s=0.1, availability=0.9, window_s=0.5)
+    # 10 requests at 0.1s spacing span [1.0, 1.9] -> 2 windows; all
+    # violations land in the first window
+    wins = burn_rate_windows(_reqs([0.5] * 3 + [0.05] * 7), spec)
+    assert len(wins) == 2
+    assert sum(w["total"] for w in wins) == 10
+    assert wins[0]["bad"] == 3 and wins[1]["bad"] == 0
+    assert abs(wins[0]["burn_rate"] - (3 / 5) / 0.1) < 1e-9
+    assert wins[1]["burn_rate"] == 0.0
+    res = evaluate(_reqs([0.5] * 3 + [0.05] * 7), spec)
+    assert res["max_window_burn_rate"] == pytest.approx(
+        wins[0]["burn_rate"])
+    assert res["max_window_burn_rate"] > res["burn_rate"]
+
+
+def test_burn_rate_degenerate_and_empty_streams():
+    spec = SLOSpec(latency_target_s=0.1, availability=0.9, window_s=1.0)
+    # empty stream: vacuously compliant, zero burn, no windows
+    res = evaluate([], spec)
+    assert res["total"] == 0 and res["compliant"]
+    assert res["burn_rate"] == 0.0 and res["windows"] == 0
+    assert res["goodput_qps"] == 0.0
+    assert burn_rate_windows([], spec) == []
+    # every completion at the same instant: exactly one window
+    same = [{"kind": "serve_request", "done_v": 2.0, "latency_s": l}
+            for l in (0.5, 0.05)]
+    wins = burn_rate_windows(same, spec)
+    assert len(wins) == 1 and wins[0]["total"] == 2
+    assert abs(wins[0]["burn_rate"] - 5.0) < 1e-9
+    # incomplete requests (done_v None) are not counted
+    res = evaluate(same + [{"kind": "serve_request", "done_v": None,
+                            "latency_s": None}], spec)
+    assert res["total"] == 2
+
+
+def test_burn_rate_non_serve_kinds_ignored():
+    spec = SLOSpec(latency_target_s=0.1)
+    events = _reqs([0.05, 0.05]) + [{"kind": "step", "step": 1},
+                                    {"kind": "serve_batch", "vnow": 9.0}]
+    res = evaluate(events, spec)
+    assert res["total"] == 2 and res["violations"] == 0
+    assert res["compliant"] and res["burn_rate"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# export: gauges + obs record
+
+
+def test_slo_export_gauges_and_log_record(tmp_path):
+    from flexflow_tpu import obs
+    from flexflow_tpu.obs.metrics import MetricsExporter, read_textfile
+
+    spec = SLOSpec(latency_target_s=0.1, availability=0.9, window_s=5.0)
+    res = evaluate(_reqs([0.05] * 8 + [0.5, 0.9]), spec)
+    metrics = MetricsExporter(str(tmp_path / "m.prom"))
+    export_gauges(metrics, res)
+    g = read_textfile(str(tmp_path / "m.prom"))
+    assert g["slo_burn_rate"] == pytest.approx(2.0)
+    assert g["slo_error_rate"] == pytest.approx(0.2)
+    assert g["slo_compliant"] == 0.0
+    assert g["slo_goodput_qps"] > 0
+    export_gauges(None, res)  # no-op, must not raise
+
+    olog = obs.RunLog(str(tmp_path / "slo.jsonl"), surface="test")
+    log_record(olog, res)
+    olog.close()
+    recs = [e for e in obs.read_run(olog.path) if e["kind"] == "slo"]
+    assert len(recs) == 1
+    assert recs[0]["violations"] == 2
+    assert recs[0]["spec"]["availability"] == 0.9
+
+
+def test_slo_report_section_and_summarize(tmp_path):
+    from flexflow_tpu import obs
+    from flexflow_tpu.apps.report import slo_main
+    from flexflow_tpu.obs.report import summarize
+
+    olog = obs.RunLog(str(tmp_path / "r.jsonl"), surface="serve")
+    for e in _reqs([0.05] * 8 + [0.5, 0.9]):
+        olog.event("serve_request", rid=0, arrival_v=0.0,
+                   admit_v=0.0, **{k: v for k, v in e.items()
+                                   if k != "kind"})
+    olog.close()
+    lines = []
+    rc = slo_main([str(tmp_path), "--target-s", "0.1",
+                   "--availability", "0.9", "--window-s", "5"],
+                  log=lines.append)
+    assert rc == 0
+    text = "\n".join(lines)
+    assert "burn" in text and "VIOLATED" in text
+    events = list(obs.read_run(olog.path))
+    spec = SLOSpec(latency_target_s=0.1, availability=0.9, window_s=5.0)
+    out = obs.RunLog(str(tmp_path / "out" / "o.jsonl"))
+    log_record(out, evaluate(events, spec))
+    out.close()
+    summ = summarize(list(obs.read_run(out.path)))
+    assert summ["slo"][0]["violations"] == 2
+    assert summ["slo"][0]["compliant"] is False
+    # an empty obs dir exits non-zero
+    empty = obs.RunLog(str(tmp_path / "empty" / "e.jsonl"))
+    empty.event("step", step=1)
+    empty.close()
+    assert slo_main([str(tmp_path / "empty")], log=lambda *a: None) == 1
+
+
+# ---------------------------------------------------------------------------
+# latency histograms
+
+
+def test_latency_buckets_fixed_and_monotone():
+    from flexflow_tpu.obs.metrics import LATENCY_BUCKETS
+
+    assert len(LATENCY_BUCKETS) == 21
+    assert LATENCY_BUCKETS[0] == pytest.approx(0.001)
+    assert LATENCY_BUCKETS[-1] == pytest.approx(100.0)
+    assert all(a < b for a, b in zip(LATENCY_BUCKETS,
+                                     LATENCY_BUCKETS[1:]))
+
+
+def test_histogram_observe_render_and_read_back(tmp_path):
+    from flexflow_tpu.obs.metrics import (LATENCY_BUCKETS,
+                                          MetricsExporter,
+                                          read_histogram, read_textfile)
+
+    path = str(tmp_path / "m.prom")
+    m = MetricsExporter(path)
+    for v in (0.0005, 0.002, 0.05, 1.3, 250.0):
+        m.observe("request_latency_s", v)
+    m.observe("request_latency_s", float("nan"))  # dropped
+    m.update(qps=12.0)
+    m.write()
+
+    text = open(path).read()
+    assert "# TYPE ff_request_latency_s histogram" in text
+    assert 'le="+Inf"' in text
+
+    h = read_histogram(path)["request_latency_s"]
+    assert h["count"] == 5.0
+    assert h["sum"] == pytest.approx(251.3525)
+    # cumulative buckets: monotone, +Inf last and equal to count
+    les = [le for le, _ in h["buckets"]]
+    cums = [c for _, c in h["buckets"]]
+    assert les[:-1] == [pytest.approx(b) for b in LATENCY_BUCKETS]
+    assert math.isinf(les[-1]) and cums[-1] == 5.0
+    assert all(a <= b for a, b in zip(cums, cums[1:]))
+    # 250s sample lands only in +Inf
+    assert cums[-2] == 4.0
+    # plain gauges still parse despite histogram lines in the file
+    g = read_textfile(path)
+    assert g["qps"] == 12.0
+    assert g["request_latency_s_count"] == 5.0
+    assert g["request_latency_s_sum"] == pytest.approx(251.3525)
+
+
+def test_read_histogram_rejects_corrupt_buckets(tmp_path):
+    from flexflow_tpu.obs.metrics import MetricsExporter, read_histogram
+
+    path = str(tmp_path / "m.prom")
+    m = MetricsExporter(path)
+    m.observe("request_ttft_s", 0.01)
+    m.observe("request_ttft_s", 0.02)
+    m.write()
+    good = open(path).read()
+    assert read_histogram(path)["request_ttft_s"]["count"] == 2.0
+
+    # break monotonicity: shrink a late cumulative count below an
+    # earlier one
+    lines = good.splitlines()
+    idx = max(i for i, l in enumerate(lines)
+              if l.startswith("ff_request_ttft_s_bucket")
+              and 'le="+Inf"' not in l)
+    name = lines[idx].rsplit(" ", 1)[0]
+    lines[idx] = name + " 0"
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError):
+        read_histogram(path)

@@ -300,3 +300,149 @@ def test_op_time_flags_parsed():
     assert cfg.op_time_every == 5 and cfg.obs_max_bytes == 1234
     cfg = FFConfig.from_args(["-op-time-every", "3"])
     assert cfg.op_time_every == 3
+
+
+# ---------------------------------------------------------------------------
+# serving + fleet lanes
+
+
+def _serve_records():
+    """A hand-built two-step serving stream: rids 0/1 admitted together
+    at v=0.1 (one admission group), rid 2 later alone."""
+    reqs = [
+        {"kind": "serve_request", "rid": 0, "arrival_v": 0.0,
+         "admit_v": 0.1, "first_token_v": 0.11, "done_v": 0.13,
+         "latency_s": 0.13, "ttft_s": 0.11, "tpot_s": 0.01,
+         "prompt_len": 4, "new_tokens": 3},
+        {"kind": "serve_request", "rid": 1, "arrival_v": 0.05,
+         "admit_v": 0.1, "first_token_v": 0.11, "done_v": 0.12,
+         "latency_s": 0.07, "ttft_s": 0.06, "tpot_s": 0.01,
+         "prompt_len": 4, "new_tokens": 2},
+        {"kind": "serve_request", "rid": 2, "arrival_v": 0.2,
+         "admit_v": 0.25, "first_token_v": 0.26, "done_v": 0.26,
+         "latency_s": 0.06, "ttft_s": 0.06, "tpot_s": 0.0,
+         "prompt_len": 4, "new_tokens": 1},
+    ]
+    batches = [
+        {"kind": "serve_batch", "step": 1, "vnow": 0.11, "active": 2,
+         "admitted": 2, "queue_depth": 0, "kv_tokens": 12,
+         "kv_frac": 0.09375},
+        {"kind": "serve_batch", "step": 2, "vnow": 0.26, "active": 1,
+         "admitted": 1, "queue_depth": 0, "kv_tokens": 5,
+         "kv_frac": 0.0390625},
+    ]
+    return reqs + batches
+
+
+def test_serve_trace_events_validate_and_cover_lifecycle():
+    events = obstrace.serve_trace_events(_serve_records())
+    trace = obstrace.chrome_trace(events)
+    assert obstrace.validate_trace(trace) == []
+    # survives the JSON round-trip Perfetto will perform
+    assert obstrace.validate_trace(json.loads(json.dumps(trace))) == []
+
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+    # one process meta + one thread meta per request lane
+    names = {e["args"]["name"] for e in by_ph["M"]}
+    assert {"serve", "req 0", "req 1", "req 2"} <= names
+    # per request: a queue span and a decode span on the SAME lane
+    spans = by_ph["X"]
+    assert len(spans) == 6
+    queue = [e for e in spans if e["cat"] == "queue"]
+    decode = [e for e in spans if e["cat"] == "decode"]
+    assert len(queue) == 3 and len(decode) == 3
+    for q, d in zip(sorted(queue, key=lambda e: e["args"]["rid"]),
+                    sorted(decode, key=lambda e: e["args"]["rid"])):
+        assert q["tid"] == d["tid"]
+        assert q["ts"] + q["dur"] == pytest.approx(d["ts"])
+        assert d["args"]["ttft_s"] is not None
+    # rids 0 and 1 decode CONCURRENTLY on separate lanes — legal
+    # because request cats are not "compute"
+    d0, d1 = (e for e in decode if e["args"]["rid"] in (0, 1))
+    assert d0["ts"] < d1["ts"] + d1["dur"] and d1["ts"] < d0["ts"] + \
+        d0["dur"]
+    # the shared admission at v=0.1 is one flow arrow (s -> f), the
+    # solo admission at 0.25 none
+    assert len(by_ph["s"]) == 1 and len(by_ph["f"]) == 1
+    assert by_ph["s"][0]["id"] == by_ph["f"][0]["id"]
+    assert by_ph["s"][0]["tid"] != by_ph["f"][0]["tid"]
+    assert by_ph["s"][0]["args"]["batch"] == 2
+    # counter lanes: queue depth, slots, KV occupancy per batch record
+    counters = {e["name"] for e in by_ph["C"]}
+    assert {"queue depth", "slots", "KV cache"} <= counters
+    kv = [e for e in by_ph["C"] if e["name"] == "KV cache"]
+    assert all(set(e["args"]) == {"kv_tokens", "kv_frac"} for e in kv)
+    # timestamps normalized: earliest arrival at ts 0
+    assert min(e["ts"] for e in spans) == 0.0
+
+
+def test_serve_trace_events_empty_and_partial():
+    # empty stream -> just the process meta event
+    events = obstrace.serve_trace_events([])
+    assert len(events) == 1 and events[0]["ph"] == "M"
+    # an in-flight request (no done_v) gets its queue span only
+    events = obstrace.serve_trace_events(
+        [{"kind": "serve_request", "rid": 7, "arrival_v": 1.0,
+          "admit_v": 1.5, "done_v": None}])
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert len(spans) == 1 and spans[0]["cat"] == "queue"
+    assert obstrace.validate_trace(
+        obstrace.chrome_trace(events)) == []
+
+
+def test_fleet_trace_events_per_job_occupancy():
+    records = [
+        {"kind": "fleet_job", "ts": 100.0, "job": "train-a",
+         "state": "running", "devices": 4},
+        {"kind": "fleet_job", "ts": 101.0, "job": "serve-b",
+         "state": "running", "devices": 2},
+        {"kind": "fleet_rebalance", "ts": 102.0,
+         "moves": [{"job": "train-a", "to": [0, 1, 2, 3, 4, 5]}]},
+        {"kind": "fleet_job", "ts": 103.0, "job": "train-a",
+         "state": "done", "devices": 6},
+        {"kind": "fleet_job", "ts": 99.5, "job": "pending-c",
+         "state": "pending"},  # no devices yet -> no sample
+    ]
+    events = obstrace.fleet_trace_events(records)
+    trace = obstrace.chrome_trace(events)
+    assert obstrace.validate_trace(trace) == []
+    counters = [e for e in events if e.get("ph") == "C"]
+    a = [e for e in counters if e["name"] == "job train-a devices"]
+    assert [e["args"]["devices"] for e in a] == [4.0, 6.0, 0.0]
+    # completion drops the lane to zero
+    assert a[-1]["args"]["devices"] == 0.0
+    b = [e for e in counters if e["name"] == "job serve-b devices"]
+    assert len(b) == 1 and b[0]["args"]["devices"] == 2.0
+    assert not [e for e in counters if "pending-c" in e["name"]]
+    # wall-clock axis normalized to the stream start
+    assert min(e["ts"] for e in counters) == 0.0
+    # no samples -> just the meta event
+    assert len(obstrace.fleet_trace_events(
+        [{"kind": "fleet_job", "job": "x", "state": "running"}])) == 1
+
+
+def test_report_serve_trace_flag(tmp_path):
+    """`report serve --trace OUT` exports the validated serving trace
+    (plus fleet lanes when fleet records share the stream)."""
+    from flexflow_tpu.apps.report import serve_main
+
+    olog = RunLog(str(tmp_path / "s.jsonl"), surface="serve")
+    for r in _serve_records():
+        olog.event(r.pop("kind"), **r)
+    olog.event("serve_summary", requests=3, completed=3, unserved=0,
+               dropped=0, qps=25.0, p50_s=0.07, p99_s=0.13, steps=2,
+               resizes=0, virtual_s=0.26, drained=False, devices=8)
+    olog.event("fleet_job", job="train-a", state="running", devices=4)
+    olog.close()
+    out = str(tmp_path / "serve.trace.json")
+    lines = []
+    rc = serve_main([str(tmp_path), "--trace", out], log=lines.append)
+    assert rc == 0
+    assert os.path.exists(out)
+    with open(out) as f:
+        trace = json.load(f)
+    assert obstrace.validate_trace(trace) == []
+    pids = {e.get("pid") for e in trace["traceEvents"]}
+    assert obstrace.PID_SERVE in pids and obstrace.PID_FLEET in pids
