@@ -3,7 +3,7 @@ PYTHON ?= python
 
 .PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
 	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke \
-	serve-smoke fleet-smoke loadtest-smoke
+	serve-smoke fleet-smoke loadtest-smoke disagg-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -17,7 +17,7 @@ native:
 # every emitted obs record kind must be rendered by obs/report.py and
 # covered by a test (tools/check_obs_kinds.py), and the static strategy
 # verifier must come up clean (lint)
-check: lint fusion-smoke serve-smoke fleet-smoke loadtest-smoke
+check: lint fusion-smoke serve-smoke disagg-smoke fleet-smoke loadtest-smoke
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
@@ -157,6 +157,28 @@ serve-smoke:
 	print('serve-smoke ok:', {k: rec[k] for k in \
 	('completed','qps','p50_s','p99_s','resizes','devices')})"
 
+# disaggregated-serving smoke (prefill/decode round): two 2-device
+# prefill replicas + one 4-device decode pool behind the router on the
+# 8-device CPU mesh, serving a seeded multi-turn session load; the smoke
+# itself asserts routed replies bit-identical to the single-pool engine,
+# >= 1 KV handoff and >= 1 session-affinity hit with zero refetches, a
+# clean mid-run drain (in-flight prefills hand off and finish, queued
+# work reported unserved), a validated Perfetto trace with the router
+# lanes, and a rendered `report serve`; stdout is one JSON record
+disagg-smoke:
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.serve --disagg-smoke \
+	| $(PYTHON) -c "import json,math,sys; \
+	rec=json.loads(sys.stdin.readline()); \
+	assert sys.stdin.readline() == '', 'stdout must be one JSON line'; \
+	assert rec['completed'] == rec['requests'] == 12, rec; \
+	assert rec['unserved'] == 0 and rec['dropped'] == 0, rec; \
+	assert rec['devices'] == 8, rec; \
+	assert math.isfinite(rec['p50_s']) and math.isfinite(rec['p99_s']), rec; \
+	print('disagg-smoke ok:', {k: rec[k] for k in \
+	('completed','qps','p50_s','p99_s','devices')})"
+
 # sustained-load harness smoke (serving observability round): a small
 # deterministic device-count sweep of the patterned load generator
 # through the engine; asserts exactly one bench-convention JSON stdout
@@ -190,6 +212,17 @@ loadtest-smoke:
 	print('loadtest-smoke ok:', {k: rec[k] for k in \
 	('metric','value','vs_baseline','sweep_points','p99_s', \
 	'ttft_p50_s','burn_rate','trace_validated')})"
+	$(PYTHON) -c "import json; \
+	art=json.load(open('SERVE_r02.json')); \
+	assert art['schema'] == 'serve_bench_v1' and art['disagg'] is True, art; \
+	vs=art['vs_r01']; \
+	assert vs['baseline'] == 'SERVE_r01.json', vs; \
+	pts=vs['points']; \
+	assert all(pts[d]['ttft_p99_speedup'] > 1.0 for d in ('2','4')), pts; \
+	assert all(pts[d]['goodput_ratio'] > 1.0 for d in ('2','4')), pts; \
+	print('loadtest-smoke: SERVE_r02 vs_r01 ok:', {d: \
+	{'ttft_p99_speedup': pts[d]['ttft_p99_speedup'], \
+	'goodput_ratio': pts[d]['goodput_ratio']} for d in ('2','4')})"
 
 # multi-tenant fleet smoke (fleet/ round): two jobs on the 8-device
 # simulated pool trade devices mid-run — training job A shrinks 6->4

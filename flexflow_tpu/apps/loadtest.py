@@ -58,6 +58,7 @@ def parse_args(argv):
         "slo_target_s": 0.25, "availability": 0.95, "slo_window_s": 2.0,
         "percentile": 99.0, "out": "", "trace": "", "obs_dir": "",
         "run_id": "", "metrics_path": "", "smoke": False,
+        "disagg": False, "baseline": "",
     }
     for a, val in flag_stream(list(argv)):
         if a in ("-n", "--requests"):
@@ -96,6 +97,10 @@ def parse_args(argv):
             opts["run_id"] = val()
         elif a in ("-metrics-path", "--metrics-path"):
             opts["metrics_path"] = val()
+        elif a == "--disagg":
+            opts["disagg"] = True
+        elif a == "--baseline":
+            opts["baseline"] = val()
         elif a == "--smoke":
             opts["smoke"] = True
     if opts["smoke"]:
@@ -113,10 +118,63 @@ def _round(v, nd=6):
     return round(v, nd) if math.isfinite(v) else v
 
 
+def _disagg_carve(devices: int) -> dict:
+    """Deterministic prefill/decode split of a ``devices``-wide sweep
+    point: half the mesh prefils (two replicas once it is >= 4 devices
+    wide), the rest decodes as one pool.  2 -> 1p/1d, 4 -> 2p/2d,
+    8 -> 2x2p/4d."""
+    prefill_devices = max(1, devices // 2)
+    decode_devices = max(1, devices - prefill_devices)
+    prefill_replicas = 2 if prefill_devices >= 4 else 1
+    return {
+        "prefill_devices": prefill_devices,
+        "decode_devices": decode_devices,
+        "prefill_replicas": prefill_replicas,
+        "per_replica_devices": prefill_devices // prefill_replicas,
+    }
+
+
+def _disagg_router(machine, devices, opts, olog, metrics, log):
+    """The sweep point's disaggregated serving stack: prefill replicas
+    on their own device slices (full forward per step) and one decode
+    pool whose virtual step is scaled by the analytic single-token
+    ratio (sim/search.decode_step_ratio) — the perf mechanism the
+    artifact measures.  Returns (router, carve, decode_step_ratio)."""
+    from flexflow_tpu.apps.serve import _build_lm
+    from flexflow_tpu.serve.engine import DEFAULT_STEP_TIME_S, ServeEngine
+    from flexflow_tpu.serve.router import ServeRouter
+    from flexflow_tpu.sim.search import decode_step_ratio
+
+    carve = _disagg_carve(devices)
+    base_step = opts["step_time_s"] or DEFAULT_STEP_TIME_S
+    prefill = []
+    for j in range(carve["prefill_replicas"]):
+        per = carve["per_replica_devices"]
+        m = machine.shrink(list(range(j * per, (j + 1) * per)))
+        pbatch = max(1, opts["slots_per_device"] * per)
+        model, _ = _build_lm(m, batch=pbatch, seed=opts["seed"],
+                             tiny=True, research_budget_s=0.5)
+        prefill.append(ServeEngine(
+            model, None, olog=olog, metrics=metrics, log=log,
+            step_time_s=base_step, phase="prefill"))
+    dm = machine.shrink(list(range(carve["prefill_devices"], devices)))
+    dbatch = max(1, opts["slots_per_device"] * carve["decode_devices"])
+    dmodel, _ = _build_lm(dm, batch=dbatch, seed=opts["seed"],
+                          tiny=True, research_budget_s=0.5)
+    ratio = decode_step_ratio(dmodel)
+    decode = [ServeEngine(dmodel, None, olog=olog, metrics=metrics,
+                          log=log, step_time_s=base_step * ratio,
+                          phase="decode")]
+    return (ServeRouter(prefill, decode, olog=olog, metrics=metrics,
+                        log=log), carve, ratio)
+
+
 def _sweep_point(machine, devices, opts, olog, metrics, log) -> dict:
     """One sweep point: build the tiny GPT with ``slots_per_device *
     devices`` decode slots on a ``devices``-wide mesh, serve the SAME
-    seeded patterned request stream, evaluate the SLO."""
+    seeded patterned request stream, evaluate the SLO.  Under
+    ``--disagg`` the same mesh is instead carved into prefill replicas
+    + a decode pool behind the router (serve/router.py)."""
     from flexflow_tpu.apps.serve import _build_lm
     from flexflow_tpu.obs.slo import SLOSpec, evaluate, log_record
     from flexflow_tpu.serve.engine import ServeEngine
@@ -125,15 +183,23 @@ def _sweep_point(machine, devices, opts, olog, metrics, log) -> dict:
     m = machine if devices >= machine.num_devices \
         else machine.shrink(list(range(devices)))
     batch = max(1, opts["slots_per_device"] * devices)
-    model, _ = _build_lm(m, batch=batch, seed=opts["seed"],
-                         tiny=True, research_budget_s=0.5)
-    engine = ServeEngine(model, None, olog=olog, metrics=metrics,
-                         log=log,
-                         step_time_s=opts["step_time_s"] or None)
-    seq = int(model._inputs[0].shape[1])
+    carve = ratio = None
+    if opts["disagg"]:
+        router, carve, ratio = _disagg_router(machine, devices, opts,
+                                              olog, metrics, log)
+        seq = int(router.decode[0].model._inputs[0].shape[1])
+        vocab = router.decode[0].model.t.vocab_size
+    else:
+        model, _ = _build_lm(m, batch=batch, seed=opts["seed"],
+                             tiny=True, research_budget_s=0.5)
+        engine = ServeEngine(model, None, olog=olog, metrics=metrics,
+                             log=log,
+                             step_time_s=opts["step_time_s"] or None)
+        seq = int(model._inputs[0].shape[1])
+        vocab = model.t.vocab_size
     reqs = patterned_requests(
         opts["requests"], seed=opts["seed"], rate_qps=opts["rate_qps"],
-        pattern=opts["pattern"], vocab_size=model.t.vocab_size,
+        pattern=opts["pattern"], vocab_size=vocab,
         prompt_len=opts["prompt_len"],
         max_new_tokens=opts["max_new_tokens"],
         max_prompt_len=max(opts["prompt_len"],
@@ -142,7 +208,7 @@ def _sweep_point(machine, devices, opts, olog, metrics, log) -> dict:
     # per-request trace lanes stay distinct
     for i, r in enumerate(reqs):
         r.rid = devices * 100000 + i
-    summary = engine.run(reqs)
+    summary = router.run(reqs) if opts["disagg"] else engine.run(reqs)
 
     spec = SLOSpec(name=f"p{opts['percentile']:g}-"
                         f"{opts['slo_target_s']:g}s",
@@ -179,9 +245,25 @@ def _sweep_point(machine, devices, opts, olog, metrics, log) -> dict:
         "steps": summary["steps"],
         "virtual_s": summary["virtual_s"],
     }
+    shape = f"{devices} device(s) x {batch} slots"
+    if opts["disagg"]:
+        point.update({
+            "prefill_devices": carve["prefill_devices"],
+            "prefill_replicas": carve["prefill_replicas"],
+            "decode_devices": carve["decode_devices"],
+            "decode_step_ratio": ratio,
+            "handoffs": summary["handoffs"],
+            "affinity_hits": summary["affinity_hits"],
+            "kv_refetches": summary["kv_refetches"],
+        })
+        shape = (f"{devices} device(s) "
+                 f"[{carve['prefill_replicas']}x"
+                 f"{carve['per_replica_devices']}dev prefill + "
+                 f"{carve['decode_devices']}dev decode, "
+                 f"step ratio {ratio:.3f}]")
     olog.event("loadtest", pattern=opts["pattern"],
                rate_qps=opts["rate_qps"], seed=opts["seed"], **point)
-    log(f"loadtest: {devices} device(s) x {batch} slots -> "
+    log(f"loadtest: {shape} -> "
         f"qps {point['qps']:.1f}, p50 {point['p50_s'] * 1e3:.0f} ms, "
         f"p99 {point['p99_s'] * 1e3:.0f} ms, ttft p50 "
         f"{point['ttft_p50_s'] * 1e3:.0f} ms, goodput "
@@ -214,6 +296,52 @@ def _write_trace(opts, olog, log) -> bool:
     return True
 
 
+def _vs_baseline_artifact(sweep, path, log):
+    """Per-device-count deltas of a ``--disagg`` sweep against a
+    committed single-pool artifact (SERVE_r01.json): same seed, same
+    traffic spec, so the TTFT-p99 speedup and goodput ratio at each
+    shared device count isolate the disaggregation win.  Returns None
+    (and logs) when the baseline artifact is missing."""
+    if not path or not os.path.exists(path):
+        log(f"loadtest: baseline artifact {path or '<unset>'} not "
+            f"found — vs_r01 omitted")
+        return None
+    with open(path) as f:
+        base = json.load(f)
+    by_dev = {int(p["devices"]): p for p in base.get("sweep", [])
+              if p.get("devices")}
+    points = {}
+    for p in sweep:
+        b = by_dev.get(int(p["devices"]))
+        if b is None:
+            continue
+        entry = {}
+        for k in ("ttft_p99_s", "p99_s", "goodput_qps",
+                  "slo_compliant"):
+            entry[f"{k}_r01"] = b.get(k)
+            entry[f"{k}_r02"] = _round(p.get(k))
+        if b.get("ttft_p99_s") and p.get("ttft_p99_s"):
+            entry["ttft_p99_speedup"] = _round(
+                b["ttft_p99_s"] / p["ttft_p99_s"], 4)
+        if b.get("goodput_qps") and p.get("goodput_qps"):
+            entry["goodput_ratio"] = _round(
+                p["goodput_qps"] / b["goodput_qps"], 4)
+        points[str(p["devices"])] = entry
+    return {"baseline": os.path.basename(path),
+            "baseline_schema": base.get("schema"),
+            "points": points}
+
+
+def _default_baseline() -> str:
+    """The committed single-pool artifact, resolved from the CWD first
+    (make runs at the repo root) then beside the package."""
+    if os.path.exists("SERVE_r01.json"):
+        return "SERVE_r01.json"
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "SERVE_r01.json")
+
+
 def run(opts, log=_err) -> dict:
     from flexflow_tpu.apps.serve import _olog_metrics
     from flexflow_tpu.machine import MachineModel
@@ -240,8 +368,9 @@ def run(opts, log=_err) -> dict:
     base, top = sweep[0], sweep[-1]
     vs_baseline = (top["goodput_qps"] / base["goodput_qps"]) \
         if base["goodput_qps"] > 0 else None
+    kind = "disagg_serve" if opts["disagg"] else "serve"
     line = {
-        "metric": f"gpt_tiny_serve_qps_{top['devices']}dev",
+        "metric": f"gpt_tiny_{kind}_qps_{top['devices']}dev",
         "value": _round(top["qps"], 4),
         "unit": "req/s",
         "vs_baseline": _round(vs_baseline, 4),
@@ -276,6 +405,14 @@ def run(opts, log=_err) -> dict:
                    ("metric", "value", "unit", "vs_baseline")},
         "sweep": [{k: _round(v) for k, v in p.items()} for p in sweep],
     }
+    if opts["disagg"]:
+        artifact["disagg"] = True
+        vs_r01 = _vs_baseline_artifact(
+            sweep, opts["baseline"] or _default_baseline(), log)
+        if vs_r01 is not None:
+            artifact["vs_r01"] = vs_r01
+            line["vs_r01"] = {d: e.get("ttft_p99_speedup")
+                              for d, e in vs_r01["points"].items()}
     if opts["out"]:
         with open(opts["out"], "w") as f:
             json.dump(artifact, f, indent=1)

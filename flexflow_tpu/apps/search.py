@@ -23,13 +23,21 @@ the delta re-simulation: ``on`` (default) prices each proposal in
 on divergence > 1e-9 (debug mode; the accepted sequence is identical in
 all three for a fixed seed).
 
-``--objective makespan|latency`` picks what the simulator prices:
+``--objective makespan|latency|decode`` picks what the simulator prices:
 ``makespan`` (default) is the full training step; ``latency`` prices ONE
 forward/decode step from the same native tables (costs / 3, no gradient
-sync, no optimizer stream) for serving-SLO search.  ``--serve`` implies
-``--objective latency`` and stamps a ``__predicted__.serve`` block
-(max_batch, per-device KV-cache bytes, forward_step_s) on the artifact —
-the handoff serve/engine.py and verify/plan.py consume.
+sync, no optimizer stream) for serving-SLO search; ``decode`` prices a
+SINGLE-TOKEN decode step (per-token forward plus each attention shard's
+KV-cache HBM stream and sequence-shard collective) for the decode pool
+of a disaggregated deployment.  ``--serve`` implies ``--objective
+latency`` and stamps a ``__predicted__.serve`` block (max_batch,
+per-device KV-cache bytes, forward_step_s) on the artifact — the handoff
+serve/engine.py and verify/plan.py consume.  ``--serve --disagg N`` adds
+per-phase blocks: the main search is the PREFILL plan, a companion
+search on an N-device virtual slice under ``decode`` fills
+``serve.decode`` (step time + inline op -> pc mapping), and
+``serve.phase`` marks which phase the artifact's own plan is —
+verify/plan.py charges the KV ring only to decode-phase plans.
 
 ``-trace`` exports the simulated per-op timeline of the FINAL plan and
 the pure-DP baseline as one Chrome/Perfetto ``trace_event`` JSON
@@ -69,6 +77,7 @@ def parse_args(argv):
         "dtype": "float32", "dcn_calibration": "", "experts": 0,
         "obs_dir": "", "run_id": "", "chains": 1, "delta": "on",
         "trace": False, "objective": None, "serve": False,
+        "disagg": 0,
     }
     from flexflow_tpu.utils.flags import flag_stream
 
@@ -136,14 +145,23 @@ def parse_args(argv):
             # forward_step_s) that serve/engine.py reads for its virtual
             # clock and verify/plan.py for the forward-only HBM vet
             opts["serve"] = True
+        elif a == "--disagg":
+            # disaggregated serving artifact (serve/router.py): the main
+            # search is the PREFILL phase's plan (latency objective);
+            # a companion search on an N-device virtual decode slice
+            # under the decode objective stamps serve.prefill /
+            # serve.decode blocks with the per-phase step times
+            opts["disagg"] = int(val())
     if opts["delta"] not in ("on", "off", "check"):
         raise SystemExit(f"-delta must be on|off|check, got "
                          f"{opts['delta']!r}")
+    if opts["disagg"]:
+        opts["serve"] = True
     if opts["objective"] is None:
         opts["objective"] = "latency" if opts["serve"] else "makespan"
-    if opts["objective"] not in ("makespan", "latency"):
-        raise SystemExit(f"--objective must be makespan|latency, got "
-                         f"{opts['objective']!r}")
+    if opts["objective"] not in ("makespan", "latency", "decode"):
+        raise SystemExit(f"--objective must be makespan|latency|decode, "
+                         f"got {opts['objective']!r}")
     return opts
 
 
@@ -377,6 +395,38 @@ def _pipeline_grounded_accept(opts, machine, strategy, pp, log):
     return ok, detail
 
 
+def _decode_companion_search(opts, cost_model, olog, log) -> dict:
+    """The ``--disagg N`` companion: search the DECODE phase's plan on
+    its own N-device virtual slice under the ``decode`` objective
+    (single-token forward + per-shard KV stream + sequence-shard
+    collective pricing — sim/search.py).  Returns the serve.decode
+    block: the searched step time plus the op -> pc mapping inline, so
+    one artifact carries both phases' plans."""
+    from flexflow_tpu.sim.search import StrategySearch
+
+    n = opts["disagg"]
+    machine = MachineModel.virtual(
+        n, Topology(devices_per_ici_group=n))
+    model = build_model(opts["model"], machine, opts["batch_size"],
+                        opts["dtype"], opts["experts"])
+    search = StrategySearch(model, machine, cost_model=cost_model,
+                            obs=olog, objective="decode")
+    strategy, info = search.search(iters=opts["iters"],
+                                   seed=opts["seed"],
+                                   **_search_kw(opts))
+    log(f"disagg decode search: {n} device(s), step "
+        f"{info['best_time']:.3e}s ({info['speedup_vs_dp']:.2f}x vs dp)")
+    return {
+        "devices": n,
+        "objective": "decode",
+        "step_time_s": info["best_time"],
+        "speedup_vs_dp": info["speedup_vs_dp"],
+        "strategies": {name: {"dims": list(pc.dims),
+                              "devices": list(pc.devices)}
+                       for name, pc in strategy.items()},
+    }
+
+
 def main(argv=None, log=print) -> dict:
     argv = list(sys.argv[1:] if argv is None else argv)
     opts = parse_args(argv)
@@ -538,6 +588,22 @@ def main(argv=None, log=print) -> dict:
                 model, opts["batch_size"], strategy=strategy),
             "forward_step_s": info["best_time"],
         }
+        if opts["objective"] == "decode":
+            # a decode-phase artifact: verify/plan.py charges the KV
+            # ring to this pool (the prefill phase's vet passes 0)
+            strategy.predicted["serve"]["phase"] = "decode"
+        if opts["disagg"]:
+            # per-phase blocks: the main search IS the prefill plan
+            # (latency objective on the searched machine); the decode
+            # phase gets its own searched step time on its own slice
+            strategy.predicted["serve"]["phase"] = "prefill"
+            strategy.predicted["serve"]["prefill"] = {
+                "devices": machine.num_devices,
+                "objective": opts["objective"],
+                "step_time_s": info["best_time"],
+            }
+            strategy.predicted["serve"]["decode"] = \
+                _decode_companion_search(opts, cost_model, olog, log)
         result["serve"] = strategy.predicted["serve"]
     if opts["trace"]:
         result["trace_path"] = _write_sim_trace(opts, search, info, olog,

@@ -64,6 +64,8 @@ def parse_args(argv):
         "queue_hi": 0, "idle_boundaries": 0, "shrink_to": 0,
         "obs_dir": "", "run_id": "", "metrics_path": "",
         "step_time_s": 0.0, "tiny": False, "smoke": False,
+        "prefill_devices": 0, "prefill_replicas": 1,
+        "decode_replicas": 1, "disagg_smoke": False,
     }
     args = list(argv)
     if args and not args[0].startswith("-"):
@@ -105,6 +107,16 @@ def parse_args(argv):
             opts["tiny"] = True
         elif a == "--smoke":
             opts["smoke"] = True
+        elif a == "--serve-prefill-devices":
+            # > 0 turns on disaggregated serving: the first N devices
+            # become the prefill pool, the rest the decode pool
+            opts["prefill_devices"] = int(val())
+        elif a == "--serve-prefill-replicas":
+            opts["prefill_replicas"] = int(val())
+        elif a == "--serve-decode-replicas":
+            opts["decode_replicas"] = int(val())
+        elif a == "--disagg-smoke":
+            opts["disagg_smoke"] = True
     return opts
 
 
@@ -211,6 +223,126 @@ def _result_line(summary, olog) -> str:
     return json.dumps(rec)
 
 
+def _decode_pool_strategy(strategies, dbatch):
+    """The decode pool's plan from a ``--serve --disagg`` artifact's
+    inline ``serve.decode.strategies`` mapping, re-marked as a
+    decode-phase artifact so verify/plan.py charges the KV ring to this
+    pool (the prefill vet passes 0).  None when the artifact carries no
+    per-phase decode plan."""
+    from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+    serve = (getattr(strategies, "predicted", None) or {}).get("serve") \
+        or {}
+    dec = serve.get("decode") or {}
+    if not dec.get("strategies"):
+        return None
+    out = Strategy({
+        name: ParallelConfig(dims=tuple(int(d) for d in e["dims"]),
+                             devices=tuple(int(d) for d in e["devices"]))
+        for name, e in dec["strategies"].items()})
+    out.predicted = {
+        "objective": "decode",
+        "serve": {"phase": "decode", "max_batch": dbatch,
+                  # where ServeEngine(phase="decode") reads its
+                  # searched virtual step time
+                  "decode": {k: dec[k] for k in ("step_time_s",
+                                                 "devices")
+                             if k in dec}},
+    }
+    return out
+
+
+def _disagg_run(opts, machine, strategies, olog, metrics, log) -> dict:
+    """Disaggregated serving: carve the mesh at --serve-prefill-devices,
+    build the prefill replicas + decode pool, vet each phase's plan,
+    route the load (serve/router.py) under the drain contract."""
+    from flexflow_tpu.serve.engine import DEFAULT_STEP_TIME_S, ServeEngine
+    from flexflow_tpu.serve.loadgen import synthetic_requests
+    from flexflow_tpu.serve.router import ServeRouter
+    from flexflow_tpu.sim.search import decode_step_ratio
+    from flexflow_tpu.utils.elastic import drain_scope
+    from flexflow_tpu.verify.plan import check_plan
+
+    n = machine.num_devices
+    p = opts["prefill_devices"]
+    pr, dr = max(1, opts["prefill_replicas"]), \
+        max(1, opts["decode_replicas"])
+    if not (0 < p < n):
+        raise SystemExit(f"--serve-prefill-devices must split the "
+                         f"{n}-device mesh, got {p}")
+    if p % pr or (n - p) % dr:
+        raise SystemExit(f"pools must split evenly: {p} prefill "
+                         f"device(s) / {pr} replica(s), {n - p} decode "
+                         f"device(s) / {dr} replica(s)")
+    if opts["model"] not in ("transformer", "gpt", "bert"):
+        raise SystemExit("disaggregated serving needs an autoregressive "
+                         "LM (transformer/gpt/bert)")
+
+    base_step = opts["step_time_s"] or DEFAULT_STEP_TIME_S
+    prefill = []
+    per = p // pr
+    # each replica is its own mesh of `per` devices (shrink renumbers
+    # ordinals 0..per-1), so the artifact's prefill plan must have been
+    # searched at the PER-REPLICA slice, not the whole pool
+    if strategies is not None:
+        span = max((max(pc.devices) for pc in strategies.values()
+                    if getattr(pc, "devices", None)), default=-1) + 1
+        if span > per:
+            raise SystemExit(
+                f"prefill plan spans {span} device(s) but each of the "
+                f"{pr} prefill replica(s) has {per}: search the prefill "
+                f"phase at the per-replica slice (apps/search --devices "
+                f"{per} --serve --disagg {n - p})")
+    for j in range(pr):
+        m = machine.shrink(list(range(j * per, (j + 1) * per)))
+        model, _ = _build_lm(m, batch=max(1, opts["batch_size"]),
+                             seed=opts["seed"], dtype=opts["dtype"],
+                             strategies=strategies, tiny=opts["tiny"])
+        if strategies is not None and j == 0:
+            check_plan(model, strategies, m,
+                       label=os.path.basename(opts["strategy"]))
+        prefill.append(ServeEngine(
+            model, None, olog=olog, metrics=metrics, log=log,
+            step_time_s=opts["step_time_s"] or None, phase="prefill"))
+    decode = []
+    dper = (n - p) // dr
+    dbatch = max(1, opts["batch_size"])
+    dstrat = _decode_pool_strategy(strategies, dbatch)
+    if dstrat is not None:
+        span = max((max(pc.devices) for pc in dstrat.values()
+                    if getattr(pc, "devices", None)), default=-1) + 1
+        if span > dper:
+            raise SystemExit(
+                f"decode plan spans {span} device(s) but each of the "
+                f"{dr} decode replica(s) has {dper}: search the decode "
+                f"companion at the per-replica slice (apps/search "
+                f"--serve --disagg {dper})")
+    for j in range(dr):
+        m = machine.shrink(list(range(p + j * dper, p + (j + 1) * dper)))
+        model, _ = _build_lm(m, batch=dbatch, seed=opts["seed"],
+                             dtype=opts["dtype"], strategies=dstrat,
+                             tiny=opts["tiny"])
+        if dstrat is not None and j == 0:
+            check_plan(model, dstrat, m,
+                       label=f"{os.path.basename(opts['strategy'])}"
+                             f"[decode]")
+        step = None if dstrat is not None and opts["step_time_s"] == 0 \
+            else base_step * decode_step_ratio(model)
+        decode.append(ServeEngine(
+            model, None, olog=olog, metrics=metrics, log=log,
+            step_time_s=step, phase="decode"))
+    router = ServeRouter(prefill, decode, olog=olog, metrics=metrics,
+                         log=log)
+    vocab = getattr(getattr(prefill[0].model, "t", None),
+                    "vocab_size", 64)
+    requests = synthetic_requests(
+        opts["requests"], seed=opts["seed"], rate_qps=opts["rate_qps"],
+        vocab_size=vocab, prompt_len=opts["prompt_len"],
+        max_new_tokens=opts["max_new_tokens"])
+    with drain_scope(log=log) as drain:
+        return router.run(requests, drain=drain)
+
+
 def serve_run(opts, log=_err) -> dict:
     """One serving run with the production wiring: plan-vetted strategy,
     obs + metrics, drain handler installed, autoscale watermarks from
@@ -227,6 +359,14 @@ def serve_run(opts, log=_err) -> dict:
     strategies = None
     if opts["strategy"]:
         strategies = Strategy.load(opts["strategy"])
+
+    if opts["prefill_devices"] > 0:
+        olog, metrics = _olog_metrics(opts)
+        summary = _disagg_run(opts, machine, strategies, olog, metrics,
+                              log)
+        summary["_olog"] = olog
+        olog.close()
+        return summary
 
     if opts["model"] in ("transformer", "gpt", "bert"):
         model, rebuild = _build_lm(
@@ -366,7 +506,125 @@ def _smoke_lifecycle(opts, log) -> dict:
     return summary
 
 
-def smoke(opts, log=_err) -> dict:
+class _DrainAfter(dict):
+    """A deterministic stand-in for the SIGTERM drain flag: reads as
+    not-requested for the first ``after`` checks, then requested — the
+    router polls once per event-loop boundary, so the drain lands
+    mid-run at a fixed virtual instant regardless of wall clock."""
+
+    def __init__(self, after: int):
+        super().__init__()
+        self.after = int(after)
+        self.checks = 0
+
+    def get(self, key, default=None):
+        if key == "requested":
+            self.checks += 1
+            return self.checks > self.after
+        return super().get(key, default)
+
+
+def _smoke_disagg(opts, log) -> dict:
+    """The deterministic disaggregation scenario (make disagg-smoke):
+    two 2-device prefill replicas + one 4-device decode pool on the
+    8-device CPU mesh, serving a seeded multi-turn ``session`` load.
+    Asserts (1) every routed reply is BIT-IDENTICAL to the same request
+    served by the single-pool engine, (2) the run exercises the router
+    for real — >= 1 KV handoff and >= 1 session-affinity hit — and
+    (3) a mid-run drain finishes in-flight work, reports the rest
+    unserved, and returns cleanly (exit 0)."""
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.obs.trace import (chrome_trace, serve_trace_events,
+                                        validate_trace)
+    from flexflow_tpu.serve.engine import (DEFAULT_STEP_TIME_S,
+                                           ServeEngine)
+    from flexflow_tpu.serve.loadgen import patterned_requests
+    from flexflow_tpu.serve.router import ServeRouter
+    from flexflow_tpu.sim.search import decode_step_ratio
+    from flexflow_tpu import obs
+
+    machine = MachineModel()
+
+    def build_pools(olog, metrics):
+        prefill = []
+        for j in range(2):
+            m = machine.shrink([2 * j, 2 * j + 1])
+            model, _ = _build_lm(m, batch=2, seed=0, tiny=True)
+            prefill.append(ServeEngine(
+                model, None, olog=olog, metrics=metrics,
+                log=lambda *a: None, step_time_s=DEFAULT_STEP_TIME_S,
+                phase="prefill"))
+        dm = machine.shrink([4, 5, 6, 7])
+        dmodel, _ = _build_lm(dm, batch=4, seed=0, tiny=True)
+        decode = [ServeEngine(
+            dmodel, None, olog=olog, metrics=metrics,
+            log=lambda *a: None,
+            step_time_s=DEFAULT_STEP_TIME_S * decode_step_ratio(dmodel),
+            phase="decode")]
+        return prefill, decode
+
+    def session_load():
+        return patterned_requests(12, seed=0, rate_qps=50.0,
+                                  pattern="session", vocab_size=64,
+                                  prompt_len=6, max_new_tokens=4)
+
+    olog, metrics = _olog_metrics(opts)
+    prefill, decode = build_pools(olog, metrics)
+    router = ServeRouter(prefill, decode, olog=olog, metrics=metrics,
+                         log=log)
+    reqs = session_load()
+    summary = router.run(reqs)
+    routed = {r.rid: list(r.reply) for r in reqs}
+
+    single_model, _ = _build_lm(machine, batch=8, seed=0, tiny=True)
+    single = ServeEngine(single_model, None, log=lambda *a: None)
+    sreqs = session_load()
+    single.run(sreqs)
+    expected = {r.rid: list(r.reply) for r in sreqs}
+    assert routed == expected, \
+        f"routed replies must be bit-identical to the single-pool " \
+        f"engine's: {routed} vs {expected}"
+    assert summary["handoffs"] >= 1 and summary["affinity_hits"] >= 1, \
+        f"smoke must exercise the router: {summary['handoffs']} " \
+        f"handoff(s), {summary['affinity_hits']} affinity hit(s)"
+    assert summary["completed"] == 12 and summary["unserved"] == 0, \
+        summary
+    assert summary["kv_refetches"] == 0, summary
+
+    # mid-run drain: fresh pools, the flag flips after three event-loop
+    # boundaries — in-flight prefills hand off and decode to completion,
+    # everything still queued or undispatched is unserved, exit clean
+    prefill2, decode2 = build_pools(olog, metrics)
+    router2 = ServeRouter(prefill2, decode2, olog=olog,
+                          metrics=metrics, log=log)
+    dsum = router2.run(session_load(), drain=_DrainAfter(3))
+    assert dsum["drained"], dsum
+    assert dsum["completed"] + dsum["unserved"] == 12 \
+        and dsum["unserved"] >= 1, dsum
+
+    if olog.enabled:
+        events = list(obs.read_run(olog.path))
+        kinds = {e["kind"] for e in events}
+        assert {"serve_handoff", "router_summary"} <= kinds, kinds
+        errors = validate_trace(chrome_trace(serve_trace_events(events)))
+        assert not errors, errors
+        from flexflow_tpu.apps.report import serve_main
+
+        rendered = []
+        rc = serve_main([olog.path], log=lambda m: rendered.append(m))
+        assert rc == 0 and rendered, "report serve must render"
+        for line in rendered:
+            log(line)
+    log(f"disagg-smoke ok: {summary['completed']} routed replies "
+        f"bit-identical to single-pool, {summary['handoffs']} "
+        f"handoff(s), {summary['affinity_hits']} affinity hit(s); "
+        f"drain left {dsum['unserved']} unserved and exited clean")
+    summary["_olog"] = olog
+    olog.close()
+    return summary
+
+
+def _require_mesh() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -375,22 +633,34 @@ def smoke(opts, log=_err) -> dict:
             f"serve --smoke needs the 8-device simulated mesh "
             f"(XLA_FLAGS=--xla_force_host_platform_device_count=8), "
             f"got {jax.device_count()} devices")
+
+
+def smoke(opts, log=_err) -> dict:
+    _require_mesh()
     _smoke_equivalence(log)
     return _smoke_lifecycle(opts, log)
+
+
+def disagg_smoke(opts, log=_err) -> dict:
+    _require_mesh()
+    return _smoke_disagg(opts, log)
 
 
 def main(argv=None, log=_err) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     opts = parse_args(argv)
-    if opts["smoke"] and not opts["obs_dir"]:
+    smoker = disagg_smoke if opts["disagg_smoke"] \
+        else (smoke if opts["smoke"] else None)
+    if smoker is not None and not opts["obs_dir"]:
         import tempfile
 
         with tempfile.TemporaryDirectory(prefix="ff-serve-smoke-") as td:
             opts["obs_dir"] = os.path.join(td, "obs")
-            summary = smoke(opts, log)
+            summary = smoker(opts, log)
             print(_result_line(summary, summary.pop("_olog")))
             return 0
-    summary = smoke(opts, log) if opts["smoke"] else serve_run(opts, log)
+    summary = smoker(opts, log) if smoker is not None \
+        else serve_run(opts, log)
     print(_result_line(summary, summary.pop("_olog")))
     return 0
 

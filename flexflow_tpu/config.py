@@ -213,6 +213,15 @@ class FFConfig:
     max_batch: int = 0
     serve_queue_hi: int = 0
     serve_idle_boundaries: int = 0
+    # disaggregated serving (serve/router.py): --serve-prefill-devices
+    # > 0 carves the mesh into a prefill pool (the first N devices,
+    # split across --serve-prefill-replicas engines searched under the
+    # latency objective) and a decode pool (the rest, split across
+    # --serve-decode-replicas engines searched under the decode
+    # objective); 0 keeps the single-pool engine
+    serve_prefill_devices: int = 0
+    serve_prefill_replicas: int = 1
+    serve_decode_replicas: int = 1
     # fleet coordinator (fleet/ package, apps/fleet.py): --fleet-quantum
     # is how many steps (train iterations / decode boundaries) each
     # running job gets per round-robin turn before the coordinator
@@ -343,6 +352,12 @@ class FFConfig:
                 cfg.serve_queue_hi = int(val())
             elif a == "--serve-idle-boundaries":
                 cfg.serve_idle_boundaries = int(val())
+            elif a == "--serve-prefill-devices":
+                cfg.serve_prefill_devices = int(val())
+            elif a == "--serve-prefill-replicas":
+                cfg.serve_prefill_replicas = int(val())
+            elif a == "--serve-decode-replicas":
+                cfg.serve_decode_replicas = int(val())
             elif a == "--fleet-quantum":
                 cfg.fleet_quantum = int(val())
             elif a == "--fleet-search-budget-s":

@@ -98,11 +98,21 @@ class Arbiter:
         self._price_cache[key] = cost
         return cost
 
+    @staticmethod
+    def _objective_for(spec) -> str:
+        """The simulator objective a job's slice is priced under:
+        decode-pool serve jobs price the single-token step (decode),
+        other serve jobs (single-pool or prefill pool) the forward pass
+        (latency), train jobs the full step (makespan)."""
+        if spec.kind != "serve":
+            return "makespan"
+        return "decode" if spec.serve_phase == "decode" else "latency"
+
     def _price_native(self, job, size: int) -> float:
         from flexflow_tpu.sim.search import price_on_slice
 
         spec = job.spec
-        objective = "latency" if spec.kind == "serve" else "makespan"
+        objective = self._objective_for(spec)
         try:
             cost, strategy, _info = price_on_slice(
                 spec.build, spec.config, size, objective=objective,

@@ -91,11 +91,24 @@ class JobSpec:
     queue_hi: int = 0              # serve demand watermark
     strategy_path: str = ""        # pre-searched strategy artifact
     search_iters: int = 200        # arbiter pricing proposals per slice
+    #: disaggregated serving demand tier (serve/router.py): "" is the
+    #: classic single-pool serve job; "prefill" prices its slice under
+    #: the latency objective (full prompt pass), "decode" under the
+    #: decode objective (single-token step + KV stream) — so a
+    #: disaggregated deployment admits as TWO JobSpecs, one per pool
+    serve_phase: str = ""
 
     def __post_init__(self):
         if self.kind not in ("train", "serve"):
             raise ValueError(f"job {self.job_id}: kind must be 'train' "
                              f"or 'serve', got {self.kind!r}")
+        if self.serve_phase not in ("", "prefill", "decode"):
+            raise ValueError(f"job {self.job_id}: serve_phase must be "
+                             f"'', 'prefill' or 'decode', got "
+                             f"{self.serve_phase!r}")
+        if self.serve_phase and self.kind != "serve":
+            raise ValueError(f"job {self.job_id}: serve_phase "
+                             f"{self.serve_phase!r} needs kind='serve'")
         if self.min_devices < 1:
             raise ValueError(f"job {self.job_id}: min_devices >= 1")
         if self.max_devices and self.max_devices < self.min_devices:

@@ -81,6 +81,19 @@ _HELP = {
     "tpot_p50_s": "serving time-per-output-token p50 (virtual seconds "
                   "per decode token after the first)",
     "requests_total": "serving requests completed this run",
+    "serve_pool_queue_depth": "disaggregated serving queue depth per "
+                              "pool, exported as ff_serve_pool_"
+                              "queue_depth{pool=\"prefill\"|\"decode\"}",
+    "serve_pool_active_slots": "occupied decode slots per pool, "
+                               "exported as ff_serve_pool_active_slots"
+                               "{pool=...}",
+    "serve_pool_step_time_s": "virtual step time per pool (the "
+                              "per-phase searched strategy's step), "
+                              "exported as ff_serve_pool_step_time_s"
+                              "{pool=...}",
+    "serve_pool_requests_total": "requests completed per pool, "
+                                 "exported as ff_serve_pool_requests_"
+                                 "total{pool=...}",
     "slo_burn_rate": "SLO error-budget burn rate over the full stream "
                      "(1.0 = burning exactly the budget)",
     "slo_max_window_burn_rate": "worst rolling-window SLO burn rate",

@@ -431,7 +431,11 @@ def _serve_section(events: List[Dict]) -> List[str]:
     batches = [e for e in events if e.get("kind") == "serve_batch"]
     resizes = [e for e in events if e.get("kind") == "serve_resize"]
     summaries = [e for e in events if e.get("kind") == "serve_summary"]
-    if not (reqs or batches or resizes or summaries):
+    handoffs = [e for e in events if e.get("kind") == "serve_handoff"]
+    refetches = [e for e in events if e.get("kind") == "kv_refetch"]
+    routers = [e for e in events if e.get("kind") == "router_summary"]
+    if not (reqs or batches or resizes or summaries or handoffs
+            or refetches or routers):
         return []
     lines = ["== serving =="]
     lat = sorted(float(e["latency_s"]) for e in reqs
@@ -467,6 +471,44 @@ def _serve_section(events: List[Dict]) -> List[str]:
             f"  batches: {len(batches)} steps, {admitted} admissions, "
             f"occupancy mean {sum(occ) / len(occ):.1f} / max "
             f"{max(occ):.0f}   {_spark(occ)}")
+        # disaggregated runs label each serve_batch with its pool —
+        # break the stream down per pool (queue depth, slot occupancy,
+        # step time), the per-pool view the router's split exists for
+        pools = sorted({b.get("pool") for b in batches if b.get("pool")})
+        for pool in pools:
+            pb = [b for b in batches if b.get("pool") == pool]
+            pocc = [float(b.get("active", 0)) for b in pb]
+            pq = [float(b.get("queue_depth", 0)) for b in pb]
+            pst = [float(b["step_time_s"]) for b in pb
+                   if b.get("step_time_s") is not None]
+            step_part = f", step {_fmt_s(pst[0])}" if pst else ""
+            lines.append(
+                f"  pool[{pool}]: {len(pb)} steps, occupancy mean "
+                f"{sum(pocc) / len(pocc):.1f} / max {max(pocc):.0f}, "
+                f"queue depth mean {sum(pq) / len(pq):.1f} / max "
+                f"{max(pq):.0f}{step_part}   {_spark(pocc)}")
+    if handoffs:
+        hb = sum(float(h.get("bytes", 0.0)) for h in handoffs)
+        hs = [float(h.get("predicted_s", 0.0)) for h in handoffs]
+        lines.append(
+            f"  handoffs: {len(handoffs)} prefill->decode "
+            f"({hb / 1e6:.2f} MB KV moved, mean "
+            f"{_fmt_s(sum(hs) / len(hs))}/handoff), "
+            f"{len(refetches)} kv_refetch(es)")
+    elif refetches:
+        lines.append(f"  kv_refetches: {len(refetches)}")
+    for r in routers:
+        pools = r.get("pools") or {}
+        pool_part = ", ".join(
+            f"{k}: {v.get('replicas', '?')}x{v.get('devices', 0) // max(v.get('replicas', 1), 1)}dev"
+            for k, v in sorted(pools.items()))
+        lines.append(
+            f"  router: {r.get('completed', 0)}/{r.get('requests', 0)} "
+            f"served across {pool_part or '?'}, "
+            f"{r.get('handoffs', 0)} handoff(s), "
+            f"{r.get('affinity_hits', 0)} affinity hit(s), "
+            f"{r.get('kv_refetches', 0)} refetch(es)"
+            + (", drained" if r.get("drained") else ""))
     for r in resizes:
         research = r.get("research") or {}
         lines.append(
@@ -663,7 +705,8 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "device_return", "step_hang", "preempt_drain",
              "ckpt_async", "lint",
              "serve_request", "serve_batch", "serve_resize",
-             "serve_summary",
+             "serve_summary", "serve_handoff", "kv_refetch",
+             "router_summary",
              "fleet_job", "fleet_placement", "fleet_rebalance",
              "fleet_summary"}
     lines = []
@@ -925,7 +968,8 @@ def summarize(events: Iterable[Dict]) -> Dict:
             }
         out["elastic"] = el
     serve_kinds = ("serve_request", "serve_batch", "serve_resize",
-                   "serve_summary")
+                   "serve_summary", "serve_handoff", "kv_refetch",
+                   "router_summary")
     if any(kinds.get(k) for k in serve_kinds):
         sv: Dict = {"counts": {k: kinds[k] for k in serve_kinds
                                if kinds.get(k)}}
@@ -967,6 +1011,23 @@ def summarize(events: Iterable[Dict]) -> Dict:
                               "tpot_p99_s", "steps",
                               "resizes", "virtual_s", "drained",
                               "devices")}
+        hoffs = [e for e in events if e.get("kind") == "serve_handoff"]
+        if hoffs:
+            sv["handoffs"] = {
+                "n": len(hoffs),
+                "bytes": sum(float(h.get("bytes", 0.0)) for h in hoffs),
+                "kv_refetches": kinds.get("kv_refetch", 0)}
+        routers = [e for e in events
+                   if e.get("kind") == "router_summary"]
+        if routers:
+            r = routers[-1]
+            sv["router"] = {k: r.get(k) for k in
+                            ("requests", "completed", "unserved",
+                             "qps", "p50_s", "p99_s", "ttft_p50_s",
+                             "ttft_p99_s", "tpot_p50_s", "steps",
+                             "devices", "pools", "handoffs",
+                             "affinity_hits", "kv_refetches",
+                             "drained")}
         out["serve"] = sv
     slos = [e for e in events if e.get("kind") == "slo"]
     if slos:

@@ -300,19 +300,29 @@ def serve_trace_events(records: Iterable[Dict], pid: int = PID_SERVE,
         completion carrying TTFT/TPOT/latency in ``args``.  Request
         cats are NOT ``compute`` — concurrent requests legitimately
         overlap across lanes and within a continuous batch;
+      * ROUTED requests (a ``serve_handoff`` record exists for the
+        rid, serve/router.py) split the lane into the full lifecycle:
+        ``queue`` (arrival -> admit), a ``prefill`` span (admit ->
+        first token, the prompt pass), a ``handoff`` flow arrow
+        (``ph: "s"``/``"f"``) spanning the priced KV transfer, then
+        the ``decode`` span from the handoff landing to completion;
       * admission flow arrows (``ph: "s"``/``"f"``): requests admitted
         at the same virtual instant are one continuous-batching
         admission group — the arrow runs from the group's first
         request lane to each other member;
       * counter lanes from ``serve_batch``: queue depth, active/
         admitted slots, and KV-cache occupancy (tokens + fraction of
-        the ``max_batch x max_seq`` rectangle) over virtual time.
+        the ``max_batch x max_seq`` rectangle) over virtual time —
+        per pool (``... [prefill]``/``... [decode]``) when the batch
+        records carry pool labels.
 
     Timestamps are shifted so the earliest arrival lands at 0 (trace
     viewers and :func:`validate_trace` want non-negative ts)."""
     records = list(records)
     reqs = [r for r in records if r.get("kind") == "serve_request"]
     batches = [r for r in records if r.get("kind") == "serve_batch"]
+    handoffs = {r.get("rid"): r for r in records
+                if r.get("kind") == "serve_handoff"}
     events = [meta_event(pid, label)]
     if not reqs and not batches:
         return events
@@ -342,17 +352,52 @@ def serve_trace_events(records: Iterable[Dict], pid: int = PID_SERVE,
                 "pid": pid, "tid": tid,
                 "args": {"rid": rid,
                          "queue_wait_s": float(admit) - float(arrival)}})
-        if admit is not None and done is not None:
+        decode_args = {"rid": rid, "latency_s": r.get("latency_s"),
+                       "ttft_s": r.get("ttft_s"),
+                       "tpot_s": r.get("tpot_s"),
+                       "prompt_len": r.get("prompt_len"),
+                       "new_tokens": r.get("new_tokens")}
+        ho = handoffs.get(rid)
+        first = r.get("first_token_v")
+        land = ho.get("handoff_v") if ho else None
+        if ho is not None and admit is not None and done is not None \
+                and first is not None and land is not None:
+            # routed lifecycle: prefill span -> handoff flow arrow
+            # (spanning the priced KV transfer) -> decode span.  Flow
+            # ids live above 1_000_000 so they never collide with the
+            # admission-group ids (which enumerate from 0).
+            events.append({
+                "name": f"prefill {rid}", "cat": "prefill", "ph": "X",
+                "ts": ts(admit),
+                "dur": max(0.0, (float(first) - float(admit)) * _US),
+                "pid": pid, "tid": tid,
+                "args": {"rid": rid, "prompt_len": r.get("prompt_len"),
+                         "from_replica": ho.get("from_replica")}})
+            flow_id = 1_000_000 + tid
+            ho_args = {"rid": rid, "bytes": ho.get("bytes"),
+                       "hops": ho.get("hops"),
+                       "predicted_s": ho.get("predicted_s"),
+                       "from_replica": ho.get("from_replica"),
+                       "to_replica": ho.get("to_replica")}
+            events.append({"name": "handoff", "cat": "handoff",
+                           "ph": "s", "id": flow_id, "ts": ts(first),
+                           "pid": pid, "tid": tid, "args": ho_args})
+            events.append({"name": "handoff", "cat": "handoff",
+                           "ph": "f", "bp": "e", "id": flow_id,
+                           "ts": ts(land), "pid": pid, "tid": tid,
+                           "args": ho_args})
+            decode_args["to_replica"] = ho.get("to_replica")
+            events.append({
+                "name": f"decode {rid}", "cat": "decode", "ph": "X",
+                "ts": ts(land),
+                "dur": max(0.0, (float(done) - float(land)) * _US),
+                "pid": pid, "tid": tid, "args": decode_args})
+        elif admit is not None and done is not None:
             events.append({
                 "name": f"decode {rid}", "cat": "decode", "ph": "X",
                 "ts": ts(admit),
                 "dur": max(0.0, (float(done) - float(admit)) * _US),
-                "pid": pid, "tid": tid,
-                "args": {"rid": rid, "latency_s": r.get("latency_s"),
-                         "ttft_s": r.get("ttft_s"),
-                         "tpot_s": r.get("tpot_s"),
-                         "prompt_len": r.get("prompt_len"),
-                         "new_tokens": r.get("new_tokens")}})
+                "pid": pid, "tid": tid, "args": decode_args})
     # admission groups -> flow arrows between member lanes
     groups: Dict[float, List[Dict]] = {}
     for r in reqs:
@@ -378,20 +423,27 @@ def serve_trace_events(records: Iterable[Dict], pid: int = PID_SERVE,
         if vnow is None:
             continue
         bts = ts(vnow)
+        # disaggregated pools get their own counter tracks ("queue
+        # depth [prefill]" / "[decode]"); single-pool runs keep the
+        # plain names.
+        pool = b.get("pool") or ""
+        suffix = f" [{pool}]" if pool else ""
         if isinstance(b.get("queue_depth"), (int, float)):
-            events.append({"name": "queue depth", "ph": "C", "pid": pid,
-                           "tid": 0, "ts": bts,
+            events.append({"name": f"queue depth{suffix}", "ph": "C",
+                           "pid": pid, "tid": 0, "ts": bts,
                            "args": {"queued": float(b["queue_depth"])}})
         slots = {k: float(b[k]) for k in ("active", "admitted")
                  if isinstance(b.get(k), (int, float))}
         if slots:
-            events.append({"name": "slots", "ph": "C", "pid": pid,
-                           "tid": 0, "ts": bts, "args": slots})
+            events.append({"name": f"slots{suffix}", "ph": "C",
+                           "pid": pid, "tid": 0, "ts": bts,
+                           "args": slots})
         kv = {k: float(b[k]) for k in ("kv_tokens", "kv_frac")
               if isinstance(b.get(k), (int, float))}
         if kv:
-            events.append({"name": "KV cache", "ph": "C", "pid": pid,
-                           "tid": 0, "ts": bts, "args": kv})
+            events.append({"name": f"KV cache{suffix}", "ph": "C",
+                           "pid": pid, "tid": 0, "ts": bts,
+                           "args": kv})
     return events
 
 

@@ -35,23 +35,35 @@ import numpy as np
 from flexflow_tpu.serve.loadgen import Request
 
 
+def _eff_arrival(req: Request) -> float:
+    """The virtual instant a request becomes admissible: its arrival,
+    or — for a request re-queued by the disaggregation router — the
+    instant its prefill->decode KV handoff lands (``handoff_v``).  The
+    request's own ``arrival_v`` is never touched, so TTFT/latency keep
+    measuring from the user-visible arrival."""
+    return req.handoff_v if req.handoff_v is not None else req.arrival_v
+
+
 class RequestQueue:
     """Arrival-ordered FIFO with virtual-time admission.
 
     ``push`` accepts requests in any order; the queue serves them by
-    ``(arrival_v, rid)``.  ``depth(vnow)`` — the number of requests that
-    have ARRIVED but not yet been admitted — is the autoscaler's grow
+    ``(effective arrival, rid)`` where the effective arrival is
+    ``handoff_v`` for a router-handed-off request and ``arrival_v``
+    otherwise.  ``depth(vnow)`` — the number of requests that have
+    ARRIVED but not yet been admitted — is the autoscaler's grow
     watermark signal."""
 
     def __init__(self, requests: Optional[Iterable[Request]] = None):
-        items = sorted(requests or [], key=lambda r: (r.arrival_v, r.rid))
+        items = sorted(requests or [],
+                       key=lambda r: (_eff_arrival(r), r.rid))
         self._q: deque = deque(items)
 
     def push(self, req: Request) -> None:
-        if self._q and (req.arrival_v, req.rid) < (self._q[-1].arrival_v,
-                                                   self._q[-1].rid):
+        if self._q and (_eff_arrival(req), req.rid) < \
+                (_eff_arrival(self._q[-1]), self._q[-1].rid):
             items = sorted(list(self._q) + [req],
-                           key=lambda r: (r.arrival_v, r.rid))
+                           key=lambda r: (_eff_arrival(r), r.rid))
             self._q = deque(items)
         else:
             self._q.append(req)
@@ -59,19 +71,20 @@ class RequestQueue:
     def pop_ready(self, vnow: float, k: int) -> List[Request]:
         """Up to ``k`` requests whose arrival time has passed, in order."""
         out: List[Request] = []
-        while self._q and len(out) < k and self._q[0].arrival_v <= vnow:
+        while self._q and len(out) < k \
+                and _eff_arrival(self._q[0]) <= vnow:
             out.append(self._q.popleft())
         return out
 
     def depth(self, vnow: float) -> int:
-        return sum(1 for r in self._q if r.arrival_v <= vnow)
+        return sum(1 for r in self._q if _eff_arrival(r) <= vnow)
 
     def pending(self) -> int:
         """All requests still queued, arrived or not."""
         return len(self._q)
 
     def next_arrival(self) -> Optional[float]:
-        return self._q[0].arrival_v if self._q else None
+        return _eff_arrival(self._q[0]) if self._q else None
 
     def drain(self) -> List[Request]:
         """Remove and return everything still queued (the graceful-drain
@@ -137,9 +150,15 @@ class ContinuousBatcher:
                     f"request {req.rid}: prompt length {len(req.tokens)} "
                     f"leaves no room to generate within the model's "
                     f"sequence window {self.max_len}")
-            req.admit_v = vnow
-            self.slots[slot_idx] = Slot(req=req,
-                                        tokens=[int(t) for t in req.tokens])
+            if req.admit_v is None:
+                # first admission only: a handoff re-admission (decode
+                # pool) keeps the prefill pool's queue-wait attribution
+                req.admit_v = vnow
+            carried = [int(t) for t in (req.carried_tokens or ())]
+            self.slots[slot_idx] = Slot(
+                req=req,
+                tokens=[int(t) for t in req.tokens] + carried,
+                generated=len(carried))
             admitted.append(slot_idx)
         return admitted
 
@@ -155,6 +174,15 @@ class ContinuousBatcher:
                 or s.generated >= s.req.max_new_tokens
                 or s.length >= self.max_len):
             s.done = True
+
+    def release(self, slot_idx: int) -> Optional[Slot]:
+        """Free one slot WITHOUT completing its request (the prefill
+        pool's handoff path: the request leaves this batcher mid-flight,
+        carrying its generated tokens to the decode pool — no
+        ``done_v``/``reply`` stamp here)."""
+        s = self.slots[slot_idx]
+        self.slots[slot_idx] = None
+        return s
 
     def reclaim(self, vnow: float) -> List[Tuple[int, Request]]:
         """Free every finished slot (ascending order) and return

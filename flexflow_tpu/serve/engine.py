@@ -91,14 +91,26 @@ class ServeEngine:
                  log=print, step_time_s: Optional[float] = None,
                  queue_hi: int = 0, idle_boundaries: int = 0,
                  shrink_to: int = 0, kv_window: Optional[int] = None,
-                 pad_id: int = 0):
+                 pad_id: int = 0, phase: str = "full", pool: str = ""):
         from flexflow_tpu import obs
 
+        if phase not in ("full", "prefill", "decode"):
+            raise ValueError(
+                f"phase must be 'full', 'prefill' or 'decode', "
+                f"got {phase!r}")
         self.model = model
         self.rebuild = rebuild
         self.olog = olog if olog is not None else obs.NULL
         self.metrics = metrics
         self.log = log
+        # disaggregation (serve/router.py): a "prefill" engine hands
+        # every request off after its first generated token (the prompt
+        # pass), a "decode" engine admits handed-off requests with their
+        # carried tokens + imported KV rows; "full" is the single-pool
+        # engine, unchanged.  ``pool`` labels this engine's obs records
+        # and gauges ("" for single-pool keeps the records unlabeled).
+        self.phase = phase
+        self.pool = pool or ("" if phase == "full" else phase)
         self.queue_hi = int(queue_hi)
         self.idle_boundaries = int(idle_boundaries)
         self.shrink_to = int(shrink_to)
@@ -124,6 +136,13 @@ class ServeEngine:
         pred = getattr(getattr(self.model.config, "strategies", None),
                        "predicted", None) or {}
         serve = pred.get("serve") or {}
+        if self.phase != "full":
+            # per-phase searched block (serve.prefill / serve.decode,
+            # stamped by apps/search.py --serve --disagg)
+            sub = serve.get(self.phase) or {}
+            t = sub.get("step_time_s")
+            if t:
+                return float(t)
         t = serve.get("forward_step_s")
         return float(t) if t else DEFAULT_STEP_TIME_S
 
@@ -189,9 +208,12 @@ class ServeEngine:
         return self.finish()
 
     def start(self, requests: Sequence[Request],
-              drain: Optional[Dict] = None) -> None:
+              drain: Optional[Dict] = None,
+              open_ended: bool = False) -> None:
         """Open a decode session over ``requests``; loop state lives on
-        the engine until :meth:`finish`."""
+        the engine until :meth:`finish`.  An ``open_ended`` session
+        never self-closes on an empty queue — the router keeps feeding
+        it via :meth:`push` and decides when it is over."""
         self._sess = {
             "t_wall0": time.perf_counter(),
             "queue": RequestQueue(requests),
@@ -199,8 +221,73 @@ class ServeEngine:
             "vnow": 0.0, "steps": 0, "idle_streak": 0,
             "draining": False, "completed": [], "unserved": [],
             "extra": self._zero_extra_inputs(), "drain": drain,
-            "done": False,
+            "done": False, "open_ended": bool(open_ended),
+            "handoffs": [],
         }
+
+    # -- router-facing session surface (serve/router.py) ----------------
+
+    def push(self, req: Request) -> None:
+        """Feed one more request into the open session's queue (the
+        router's admission / handoff path)."""
+        s = self._sess
+        if s is None:
+            raise RuntimeError("serve: no open session — call start() "
+                               "before push()")
+        s["queue"].push(req)
+
+    def advance_to(self, v: float) -> None:
+        """Advance the session's virtual clock to the router's global
+        event time (never backwards)."""
+        s = self._sess
+        if s is not None and v > s["vnow"]:
+            s["vnow"] = float(v)
+
+    def next_ready_v(self) -> Optional[float]:
+        """The earliest virtual instant this session can do work: its
+        current vnow while slots are in flight, the next queued
+        (effective) arrival while idle, None when it has nothing at
+        all — the router's event-selection signal."""
+        s = self._sess
+        if s is None:
+            return None
+        if s["batcher"].num_active():
+            return float(s["vnow"])
+        nxt = s["queue"].next_arrival()
+        if nxt is None:
+            return None
+        return float(max(s["vnow"], nxt))
+
+    def take_handoffs(self) -> List[Request]:
+        """Pop the requests this (prefill) session handed off since the
+        last call — each carries ``carried_tokens`` + ``kv_payload``,
+        ready for a decode engine's queue."""
+        s = self._sess
+        if s is None:
+            return []
+        out = s["handoffs"]
+        s["handoffs"] = []
+        return out
+
+    def load(self) -> int:
+        """Queued + in-flight work in the open session — the router's
+        least-loaded admission signal."""
+        s = self._sess
+        if s is None:
+            return 0
+        return int(s["queue"].pending()) + int(s["batcher"].num_active())
+
+    def drain_queue(self) -> List[Request]:
+        """Remove and return every still-queued request (the router's
+        drain path: queued work is unserved, in-flight work finishes)."""
+        s = self._sess
+        return s["queue"].drain() if s is not None else []
+
+    def session_completed(self) -> List[Request]:
+        """The open session's completed requests so far (the router
+        reads these before :meth:`finish` to merge pool results)."""
+        s = self._sess
+        return list(s["completed"]) if s is not None else []
 
     def pending(self) -> bool:
         """Work remains in the open session (queued or in-flight)."""
@@ -235,7 +322,8 @@ class ServeEngine:
             return False
         queue, batcher = s["queue"], s["batcher"]
         if not (queue.pending() or batcher.num_active()):
-            s["done"] = True
+            if not s["open_ended"]:
+                s["done"] = True
             return False
         drain = s["drain"]
         if drain is not None and drain.get("requested") \
@@ -247,6 +335,17 @@ class ServeEngine:
                      f"{len(s['unserved'])} queued request(s) unserved")
         vnow = s["vnow"]
         admitted = [] if s["draining"] else batcher.admit(queue, vnow)
+        if self.phase == "decode" and self.kv_cache is not None:
+            # handed-off requests arrive with their prefill pool's
+            # exported KV rows: import them under THIS layout's ring so
+            # the forward only fills positions generated here
+            for slot_idx in admitted:
+                slot = batcher.slots[slot_idx]
+                if slot is not None and slot.req.kv_payload is not None:
+                    filled = self.kv_cache.import_request(
+                        slot_idx, slot.req.kv_payload)
+                    self._kv_filled[slot_idx] = filled
+                    slot.req.kv_payload = None
         depth = queue.depth(vnow)
         if (self.queue_hi > 0 and depth >= self.queue_hi
                 and self._parked and not s["draining"]):
@@ -258,7 +357,8 @@ class ServeEngine:
         if batcher.num_active() == 0:
             nxt = queue.next_arrival()
             if nxt is None:
-                s["done"] = True
+                if not s["open_ended"]:
+                    s["done"] = True
                 return False  # drained queue, no in-flight work
             # idle boundary: no work until the next arrival
             s["idle_streak"] += 1
@@ -293,10 +393,31 @@ class ServeEngine:
             batcher.record_token(slot_idx, nxt_tok)
             if slot.generated == 1:
                 # the request's FIRST token materialized this step —
-                # the TTFT stamp every serve_request record carries
+                # the TTFT stamp every serve_request record carries.
+                # A handed-off request re-enters the decode pool with
+                # ``generated == len(carried_tokens) >= 1`` already, so
+                # the prefill pool's stamp is never overwritten.
                 slot.req.first_token_v = done_v
         s["vnow"] = vnow = done_v
         s["steps"] += 1
+        if self.phase == "prefill":
+            # the prompt pass is done: every still-running slot leaves
+            # this pool carrying its generated token(s) and its exported
+            # KV rows — the router routes it to a decode replica.
+            # (Slots that finished outright — 1-token budget or instant
+            # EOS — fall through to the normal reclaim below.)
+            for slot_idx, slot in active:
+                if slot.done:
+                    continue
+                req = slot.req
+                req.carried_tokens = slot.tokens[len(req.tokens):]
+                if self.kv_cache is not None:
+                    req.kv_payload = self.kv_cache.export_request(
+                        slot_idx)
+                    self.kv_cache.reclaim(slot_idx)
+                self._kv_filled[slot_idx] = 0
+                batcher.release(slot_idx)
+                s["handoffs"].append(req)
         for slot_idx, req in batcher.reclaim(vnow):
             if self.kv_cache is not None:
                 self.kv_cache.reclaim(slot_idx)
@@ -309,11 +430,14 @@ class ServeEngine:
                 done_v=req.done_v, latency_s=req.latency_s,
                 ttft_s=req.ttft_s, tpot_s=req.tpot_s,
                 prompt_len=len(req.tokens),
-                new_tokens=len(req.reply or ()), wall_s=req.wall_s)
+                new_tokens=len(req.reply or ()), wall_s=req.wall_s,
+                pool=self.pool)
         self.olog.event("serve_batch", step=s["steps"], vnow=vnow,
                         active=len(active), admitted=len(admitted),
                         queue_depth=depth,
                         devices=self.model.machine.num_devices,
+                        pool=self.pool,
+                        step_time_s=self.step_time_s,
                         **self._kv_occupancy())
         self._update_gauges(s["completed"], depth, vnow)
         return True
@@ -495,7 +619,8 @@ class ServeEngine:
         strategy, research = research_strategy(
             cfg, self.rebuild, new_machine,
             getattr(cfg, "strategies", None), olog=self.olog,
-            log=self.log, objective="latency")
+            log=self.log,
+            objective="decode" if self.phase == "decode" else "latency")
         research_s = time.perf_counter() - t_search
         final_cfg = copy.copy(cfg)
         final_cfg.strategies = strategy
@@ -544,6 +669,24 @@ class ServeEngine:
     def _update_gauges(self, completed, depth, vnow) -> None:
         if self.metrics is None:
             return
+        if self.pool:
+            # a pooled engine writes ONLY its labeled series — two pools
+            # scribbling the aggregate gauges would just flap them; the
+            # router writes the fleet-wide aggregate itself.  E.g.
+            # ff_serve_pool_queue_depth{pool="prefill"}.
+            labels = {"pool": self.pool}
+            s = self._sess
+            self.metrics.update_labeled(
+                "serve_pool_queue_depth", labels, depth)
+            self.metrics.update_labeled(
+                "serve_pool_active_slots", labels,
+                s["batcher"].num_active() if s is not None else 0)
+            self.metrics.update_labeled(
+                "serve_pool_step_time_s", labels, self.step_time_s)
+            self.metrics.update_labeled(
+                "serve_pool_requests_total", labels, len(completed))
+            self.metrics.write()
+            return
         lat = [r.latency_s for r in completed if r.latency_s is not None]
         ttft = [r.ttft_s for r in completed if r.ttft_s is not None]
         tpot = [r.tpot_s for r in completed if r.tpot_s is not None]
@@ -581,6 +724,7 @@ class ServeEngine:
             "wall_s": wall_s,
             "drained": bool(drained),
             "devices": self.model.machine.num_devices,
+            "pool": self.pool,
         }
         self.olog.event("serve_summary", **summary)
         self._update_gauges(completed, 0, vnow)

@@ -199,3 +199,95 @@ class KVCache:
         self.k[:, slot] = 0
         self.v[:, slot] = 0
         self.lengths[slot] = 0
+
+    # -- prefill -> decode handoff (serve/router.py) ---------------------
+
+    def export_request(self, slot: int) -> Optional[Dict]:
+        """Pack one slot's surviving ring rows for a cross-pool handoff:
+        every layer's K/V in LOGICAL position order (oldest surviving
+        row first, exactly :meth:`read`'s contract) plus the slot's
+        logical length, so :meth:`import_request` can re-ring them under
+        a DIFFERENT (s, h, n) grid / window.  None for an empty slot."""
+        n = int(self.lengths[slot])
+        if n == 0:
+            return None
+        kept = min(n, self.layout.max_seq)
+        layers = self.layout.num_layers
+        k = np.stack([self.read(li, slot)[0] for li in range(layers)])
+        v = np.stack([self.read(li, slot)[1] for li in range(layers)])
+        return {"k": k, "v": v, "length": n,
+                "start": n - kept,
+                "grid": [self.layout.s_parts, self.layout.h_parts,
+                         self.layout.n_parts]}
+
+    def import_request(self, slot: int, payload: Dict) -> int:
+        """Unpack an :meth:`export_request` payload into ``slot`` of
+        THIS cache (the decode layout's ring), re-writing each row at
+        its logical position so a narrower destination window keeps
+        exactly the newest rows it can hold.  Returns the number of
+        logical positions now filled — what the engine records as
+        already-cached so the decode forward only fills NEW positions."""
+        if payload is None:
+            return 0
+        k, v = payload["k"], payload["v"]
+        if (k.shape[0] != self.layout.num_layers
+                or k.shape[2] != self.layout.num_heads
+                or k.shape[3] != self.layout.head_dim):
+            raise ValueError(
+                f"kv handoff shape mismatch: payload "
+                f"{tuple(k.shape)} vs layout "
+                f"({self.layout.num_layers}, *, {self.layout.num_heads}, "
+                f"*, {self.layout.head_dim})")
+        self.reclaim(slot)
+        start = int(payload["start"])
+        for li in range(self.layout.num_layers):
+            self.write_span(li, slot, start, k[li], v[li])
+        # the exporter's logical length survives even when this window
+        # kept fewer rows (ring semantics: oldest rows fell off)
+        self.lengths[slot] = int(payload["length"])
+        return int(payload["length"])
+
+
+def plan_kv_handoff(src_layout: KVCacheLayout, dst_layout: KVCacheLayout,
+                    length: int, *, src_topology=None,
+                    dst_topology=None) -> Dict:
+    """Byte/hop accounting for moving one request's filled KV rows from
+    the prefill layout's (s, h, n) grid to the decode layout's — the
+    cross-pool sibling of ``parallel/regrid.plan_state_migration``: no
+    mesh spans both pools at once, so the rows are gathered off the
+    source shards (one hop when the source grid actually splits them),
+    cross the pool boundary (one hop, always), and are re-placed onto
+    the destination shards (one hop when the destination grid splits).
+
+    Returns ``{"bytes", "hops", "predicted_s", "rows"}`` — pure
+    accounting, recorded per request as the ``serve_handoff`` obs
+    event; the actual movement is the host-side export/import above."""
+    from flexflow_tpu.sim.cost_model import TpuChipPerf
+
+    rows = min(int(length), src_layout.max_seq)
+    kept = min(rows, dst_layout.max_seq)
+    kb = (2.0 * src_layout.num_layers * rows * src_layout.num_heads
+          * src_layout.head_dim * dtype_bytes(src_layout.dtype))
+    perf = TpuChipPerf()
+    ici_bw = getattr(src_topology, "ici_bandwidth", None) \
+        or perf.hbm_bandwidth / 10.0
+    ici_lat = getattr(src_topology, "ici_latency", 0.0) or 1e-6
+    dst_bw = getattr(dst_topology, "ici_bandwidth", None) or ici_bw
+    dst_lat = getattr(dst_topology, "ici_latency", 0.0) or ici_lat
+    hops = 1            # the cross-pool transfer itself
+    secs = kb / ici_bw + ici_lat
+    src_parts = (src_layout.s_parts * src_layout.h_parts
+                 * src_layout.n_parts)
+    if src_parts > 1:
+        # gather the sharded rows onto the exporting host copy
+        hops += 1
+        secs += kb / ici_bw + ici_lat
+    dst_parts = (dst_layout.s_parts * dst_layout.h_parts
+                 * dst_layout.n_parts)
+    dst_kb = kb * (kept / rows) if rows else 0.0
+    if dst_parts > 1:
+        # sharded re-place: each destination device receives its slice
+        hops += 1
+        secs += dst_kb / dst_parts / dst_bw + dst_lat
+    return {"bytes": kb, "hops": hops, "predicted_s": secs,
+            "rows": rows, "rows_kept": kept}

@@ -1,0 +1,297 @@
+"""Multi-replica prefill/decode router: queue-aware admission, KV
+handoff, session affinity, drain.
+
+The disaggregation front-end the ROADMAP's "serving at
+millions-of-users scale" item names: arrivals are admitted to the
+least-loaded PREFILL replica (each a ``ServeEngine(phase="prefill")``
+on its own device slice, searched under ``--objective latency``); a
+prefill replica runs exactly the prompt pass — its completing step
+stamps ``first_token_v``, so TTFT measures prompt processing — then
+hands the request off with its generated token(s) and exported KV rows
+(``serve/kv_cache.py::KVCache.export_request``) to a DECODE replica
+(``phase="decode"``, searched under the ``decode`` objective), where
+the re-imported ring continues the tail.  Each handoff is priced by
+``plan_kv_handoff`` (plan_state_migration-style byte/hop accounting)
+and recorded as one ``serve_handoff`` obs event; the priced transfer
+time is when the request becomes admissible on the decode side
+(``Request.handoff_v`` — the batcher's effective-arrival ordering).
+
+**Session affinity**: follow-up requests of a multi-turn session (the
+loadgen ``session`` pattern) route to the decode replica already
+holding their KV rows — an LRU residency set per replica models cache
+occupancy; when a session's rows were evicted the miss is recorded as
+one explicit ``kv_refetch`` event and the request falls back to the
+least-loaded replica (which becomes the session's new home).
+
+**Drain** follows the single-pool SIGTERM contract
+(utils/elastic.install_drain_handler): new arrivals stop (unserved),
+queued-but-unadmitted prefill work is unserved, in-flight prefills
+finish and their handoffs decode to completion.
+
+Time is the same VIRTUAL clock the engines keep (serve/loadgen.py):
+the router is a deterministic event loop over the engines'
+``next_ready_v()`` instants — ties break prefill-before-decode then
+ascending replica index — so every latency, route and handoff is
+bit-reproducible under a seeded load.  One ``router_summary`` obs
+event closes each run.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+from flexflow_tpu.serve.engine import ServeEngine, _percentile
+from flexflow_tpu.serve.kv_cache import plan_kv_handoff
+from flexflow_tpu.serve.loadgen import Request
+
+#: sessions an LRU residency set holds per decode replica, as a
+#: multiple of the replica's slot count — beyond it the oldest
+#: session's KV rows are considered evicted (kv_refetch on return)
+DEFAULT_RESIDENCY_FACTOR = 4
+
+
+class ServeRouter:
+    """Front-end over ``prefill`` and ``decode`` ServeEngine replicas.
+
+    The engines must be constructed with the matching ``phase`` (and
+    are labeled by their phase's pool); the router drives their
+    open-ended sessions directly — :meth:`run` is the whole lifecycle.
+    """
+
+    def __init__(self, prefill: Sequence[ServeEngine],
+                 decode: Sequence[ServeEngine], *, olog=None,
+                 metrics=None, log=print,
+                 residency_factor: int = DEFAULT_RESIDENCY_FACTOR):
+        from flexflow_tpu import obs
+
+        if not prefill or not decode:
+            raise ValueError("router needs >= 1 prefill and >= 1 "
+                             "decode replica")
+        for eng in prefill:
+            if eng.phase != "prefill":
+                raise ValueError("prefill replicas must be "
+                                 "ServeEngine(phase='prefill')")
+        for eng in decode:
+            if eng.phase != "decode":
+                raise ValueError("decode replicas must be "
+                                 "ServeEngine(phase='decode')")
+        self.prefill = list(prefill)
+        self.decode = list(decode)
+        self.olog = olog if olog is not None else obs.NULL
+        self.metrics = metrics
+        self.log = log
+        # session affinity state: where each session's KV rows live,
+        # plus each decode replica's LRU residency set
+        self._session_home: Dict[int, int] = {}
+        self._residency: List[OrderedDict] = [OrderedDict()
+                                              for _ in self.decode]
+        self._residency_cap = [max(1, int(residency_factor)
+                                   * eng.max_batch)
+                               for eng in self.decode]
+        self.handoffs = 0
+        self.affinity_hits = 0
+        self.kv_refetches = 0
+        self._seen_sessions: set = set()
+
+    # ------------------------------------------------------------------
+    # routing decisions
+
+    def _least_loaded(self, engines: Sequence[ServeEngine]) -> int:
+        """Lowest (load, index) — queue depth + active slots, the
+        serve_batch watermark signal read live off each session."""
+        loads = [(eng.load(), i) for i, eng in enumerate(engines)]
+        return min(loads)[1]
+
+    def _touch_residency(self, replica: int, sid: int) -> None:
+        res = self._residency[replica]
+        res[sid] = True
+        res.move_to_end(sid)
+        while len(res) > self._residency_cap[replica]:
+            evicted, _ = res.popitem(last=False)
+            # the evicted session's next follow-up will kv_refetch
+            if self._session_home.get(evicted) == replica:
+                del self._session_home[evicted]
+
+    def _route_decode(self, req: Request) -> int:
+        """Pick the decode replica for one handed-off request: session
+        home while its rows are resident, else least-loaded (with an
+        explicit kv_refetch record when eviction forced the miss)."""
+        sid = req.session
+        if sid is not None:
+            home = self._session_home.get(sid)
+            if home is not None and sid in self._residency[home]:
+                self.affinity_hits += 1
+                self._touch_residency(home, sid)
+                return home
+            if home is None and any(sid in r for r in self._residency):
+                # unreachable by construction (home tracks residency),
+                # kept as a loud guard for the invariant
+                raise AssertionError("residency without a session home")
+            if sid in self._seen_sessions:
+                # the session served here before but its rows are gone —
+                # the decode replica must refetch/rebuild the prefix
+                self.kv_refetches += 1
+                self.olog.event("kv_refetch", rid=req.rid, session=sid,
+                                old_replica=home)
+        replica = self._least_loaded(self.decode)
+        if sid is not None:
+            self._session_home[sid] = replica
+            self._touch_residency(replica, sid)
+            self._seen_sessions.add(sid)
+        return replica
+
+    def _dispatch_handoffs(self, src_idx: int,
+                           eng: ServeEngine) -> None:
+        """Price and route every request ``eng`` handed off this step."""
+        for req in eng.take_handoffs():
+            dst_idx = self._route_decode(req)
+            dst = self.decode[dst_idx]
+            plan = plan_kv_handoff(
+                eng.kv_layout, dst.kv_layout,
+                len(req.tokens) if req.kv_payload is None
+                else int(req.kv_payload["length"]),
+                src_topology=eng.model.machine.topology,
+                dst_topology=dst.model.machine.topology)
+            # prefill finished this request's prompt pass at
+            # first_token_v; the priced transfer lands it on the decode
+            # side — the batcher's effective arrival for re-admission
+            base = req.first_token_v if req.first_token_v is not None \
+                else req.arrival_v
+            req.handoff_v = base + plan["predicted_s"]
+            self.handoffs += 1
+            self.olog.event(
+                "serve_handoff", rid=req.rid, session=req.session,
+                from_replica=src_idx, to_replica=dst_idx,
+                bytes=plan["bytes"], hops=plan["hops"],
+                predicted_s=plan["predicted_s"], rows=plan["rows"],
+                handoff_v=req.handoff_v,
+                carried=len(req.carried_tokens or ()))
+            dst.push(req)
+
+    # ------------------------------------------------------------------
+    # the event loop
+
+    def run(self, requests: Sequence[Request],
+            drain: Optional[Dict] = None) -> Dict:
+        """Serve ``requests`` through the pools to completion (or
+        drain); returns the merged summary (also the ``router_summary``
+        obs record)."""
+        t_wall0 = time.perf_counter()
+        self._seen_sessions = set()
+        for eng in self.prefill + self.decode:
+            eng.start([], open_ended=True)
+        arrivals = sorted(requests, key=lambda r: (r.arrival_v, r.rid))
+        ptr = 0
+        draining = False
+        unserved: List[Request] = []
+        engines = [(eng, "prefill", i)
+                   for i, eng in enumerate(self.prefill)] \
+            + [(eng, "decode", i) for i, eng in enumerate(self.decode)]
+        while True:
+            if drain is not None and drain.get("requested") \
+                    and not draining:
+                draining = True
+                unserved.extend(arrivals[ptr:])
+                ptr = len(arrivals)
+                for eng in self.prefill:
+                    unserved.extend(eng.drain_queue())
+                self.log(f"serve-router: drain requested — "
+                         f"{len(unserved)} queued/undispatched "
+                         f"request(s) unserved, in-flight work "
+                         f"finishing")
+            candidates = []
+            if ptr < len(arrivals):
+                candidates.append(arrivals[ptr].arrival_v)
+            for eng, _, _ in engines:
+                v = eng.next_ready_v()
+                if v is not None:
+                    candidates.append(v)
+            if not candidates:
+                break
+            t = min(candidates)
+            while ptr < len(arrivals) and arrivals[ptr].arrival_v <= t:
+                idx = self._least_loaded(self.prefill)
+                self.prefill[idx].push(arrivals[ptr])
+                ptr += 1
+            # step every engine ready at t — prefill first so this
+            # boundary's handoffs are queued before decode steps at
+            # later instants are chosen
+            for eng, kind, i in engines:
+                v = eng.next_ready_v()
+                if v is None or v > t:
+                    continue
+                eng.advance_to(t)
+                eng.step_once()
+                if kind == "prefill":
+                    self._dispatch_handoffs(i, eng)
+        completed: List[Request] = []
+        steps = resizes = 0
+        pools: Dict[str, Dict] = {}
+        virtual_s = 0.0
+        for eng, kind, i in engines:
+            completed.extend(eng.session_completed())
+            summ = eng.finish()
+            steps += summ["steps"]
+            resizes += summ["resizes"]
+            virtual_s = max(virtual_s, summ["virtual_s"])
+            pool = pools.setdefault(kind, {
+                "replicas": 0, "devices": 0, "steps": 0,
+                "completed": 0})
+            pool["replicas"] += 1
+            pool["devices"] += eng.model.machine.num_devices
+            pool["steps"] += summ["steps"]
+            pool["completed"] += summ["completed"]
+        completed.sort(key=lambda r: (r.done_v, r.rid))
+        summary = self._summarize(completed, unserved, virtual_s,
+                                  steps, resizes, pools,
+                                  time.perf_counter() - t_wall0,
+                                  drained=draining)
+        return summary
+
+    # ------------------------------------------------------------------
+    # reporting
+
+    def _summarize(self, completed, unserved, vnow, steps, resizes,
+                   pools, wall_s, drained=False) -> Dict:
+        lat = [r.latency_s for r in completed if r.latency_s is not None]
+        ttft = [r.ttft_s for r in completed if r.ttft_s is not None]
+        tpot = [r.tpot_s for r in completed if r.tpot_s is not None]
+        devices = sum(p["devices"] for p in pools.values())
+        summary = {
+            "requests": len(completed) + len(unserved),
+            "completed": len(completed),
+            "unserved": len(unserved),
+            "dropped": 0,
+            "qps": (len(completed) / vnow) if vnow > 0 else 0.0,
+            "p50_s": _percentile(lat, 50),
+            "p99_s": _percentile(lat, 99),
+            "ttft_p50_s": _percentile(ttft, 50),
+            "ttft_p99_s": _percentile(ttft, 99),
+            "tpot_p50_s": _percentile(tpot, 50),
+            "tpot_p99_s": _percentile(tpot, 99),
+            "steps": steps,
+            "resizes": resizes,
+            "virtual_s": vnow,
+            "wall_s": wall_s,
+            "drained": bool(drained),
+            "devices": devices,
+            "pools": pools,
+            "handoffs": self.handoffs,
+            "affinity_hits": self.affinity_hits,
+            "kv_refetches": self.kv_refetches,
+        }
+        self.olog.event("router_summary", **summary)
+        if self.metrics is not None:
+            self.metrics.update(
+                qps=summary["qps"],
+                queue_depth=0,
+                latency_p50_s=summary["p50_s"] if lat else None,
+                latency_p99_s=summary["p99_s"] if lat else None,
+                ttft_p50_s=summary["ttft_p50_s"] if ttft else None,
+                ttft_p99_s=summary["ttft_p99_s"] if ttft else None,
+                tpot_p50_s=summary["tpot_p50_s"] if tpot else None,
+                requests_total=len(completed))
+            self.metrics.write()
+        return summary

@@ -450,7 +450,19 @@ class StrategySearch:
             rows keep their cost — the cast happens once per step in
             both regimes.  Everything downstream (delta re-sim, chunked
             MCMC, ``simulate_trace``, the breakdown) prices the serving
-            step with no further changes."""
+            step with no further changes;
+          * ``"decode"`` — one SINGLE-TOKEN decode step of a
+            disaggregated serving deployment: the latency transform
+            above, then every candidate's compute shrinks to its
+            one-token column (cost / seq — the matmuls are
+            batch*1-token GEMVs, HBM-bound on the weight stream) and
+            each attention candidate is charged the KV-cache traffic
+            its (s, h, n) grid implies: streaming the cache shard from
+            HBM every step, plus one ring-rotation hop per extra 's'
+            part (context-parallel decode circulates the query past
+            each sequence shard).  This is what makes the search prefer
+            wider head/batch splits and shallower sequence splits for
+            the decode pool than for prefill."""
         from flexflow_tpu import obs as _obs
 
         from flexflow_tpu.sim.cost_model import param_byte_scale
@@ -467,9 +479,9 @@ class StrategySearch:
             param_scale=self._param_scale)
         self.max_per_axis = max_per_axis
         self.placement = placement
-        if objective not in ("makespan", "latency"):
+        if objective not in ("makespan", "latency", "decode"):
             raise ValueError(
-                f"objective must be 'makespan' or 'latency', "
+                f"objective must be 'makespan', 'latency' or 'decode', "
                 f"got {objective!r}")
         self.objective = objective
         self.obs = obs or _obs.NULL
@@ -661,7 +673,7 @@ class StrategySearch:
             costs[i] = self.cost_model.op_cost(op, pc)
         if hasattr(self.cost_model, "flush"):
             self.cost_model.flush()
-        if self.objective == "latency":
+        if self.objective in ("latency", "decode"):
             # forward-only pricing (constructor docstring): the cost
             # model's 3.0x fwd+bwd+wgrad convention makes the forward
             # step exactly a third of every candidate's compute and
@@ -673,6 +685,39 @@ class StrategySearch:
                 costs[i] /= 3.0
                 colls[i] /= 3.0
             pbytes = [0.0] * len(pbytes)
+        if self.objective == "decode":
+            # single-token step (constructor docstring): the forward
+            # third shrinks to its one-token column, and attention
+            # candidates pick up the KV-cache terms their grid implies —
+            # the decode pool's search sees cache traffic the prefill
+            # pool's 'latency' search never pays.
+            from flexflow_tpu.ops.attention import MultiHeadAttention
+            from flexflow_tpu.sim.cost_model import dtype_bytes
+            kv_elem = dtype_bytes(
+                getattr(getattr(self.model, "config", None),
+                        "compute_dtype", "float32"))
+            for i, op, pc in cost_pairs:
+                shape = op.inputs[0].shape if op.inputs else ()
+                seq = int(shape[1]) if len(shape) >= 2 else 1
+                costs[i] /= max(seq, 1)
+                if not isinstance(op, MultiHeadAttention):
+                    continue
+                dims = tuple(pc.dims) + (1,) * (3 - len(pc.dims))
+                s_p, h_p, n_p = int(dims[0]), int(dims[1]), int(dims[2])
+                batch = int(shape[0]) if len(shape) >= 1 else 1
+                # this device's K+V shard, streamed from HBM each step
+                kv_shard = (2.0 * -(-batch // max(n_p, 1))
+                            * -(-op.num_heads // max(h_p, 1))
+                            * -(-seq // max(s_p, 1))
+                            * op.head_dim * kv_elem)
+                costs[i] += kv_shard / (perf.hbm_bandwidth
+                                        * perf.vector_efficiency)
+                if s_p > 1:
+                    # ring context parallelism: the one-token query
+                    # visits every sequence shard — one ICI rotation of
+                    # the shard's partial attention state per extra part
+                    colls[i] += (s_p - 1) * (kv_shard / topo.ici_bandwidth
+                                             + topo.ici_latency)
         # un-silence the pruning (VERDICT weak #5): what the search space
         # actually is, and what divisibility/memory removed from it
         logger.info(
@@ -721,7 +766,7 @@ class StrategySearch:
         # momentum rate).  Sharded params stream only their shard, but
         # DP — where this matters — replicates everything; charge the
         # whole footprint (upper bound for TP shards).
-        if self.objective == "latency":
+        if self.objective in ("latency", "decode"):
             # serving runs no optimizer pass; the zero also keeps the
             # "_opt_stream" sync event out of simulate_trace (emitted
             # only when > 0)
@@ -1284,3 +1329,54 @@ def price_on_slice(rebuild, config, num_devices, *,
                                chunks=4, chains=1, delta=True,
                                start=start, budget_s=budget_s)
     return float(info["best_time"]), strategy, info
+
+
+def decode_step_ratio(model, strategy=None) -> float:
+    """Deterministic analytic ratio of one single-token DECODE step to
+    one full-prompt forward step for ``model`` under ``strategy`` — no
+    native simulator, no MCMC, no wall clock, so a serving driver can
+    derive a decode-pool virtual step time (``base_step * ratio``) that
+    is bit-reproducible across runs (the SERVE_r02 artifact contract).
+
+    Both numerator and denominator are priced with the same
+    :class:`AnalyticCostModel` forward thirds the ``"latency"`` /
+    ``"decode"`` objectives use: the decode step takes each op's
+    one-token column (cost / seq) plus every attention op's KV-cache
+    HBM stream for its strategy grid.  Attention-free models (no cache)
+    still price the one-token column.  Clamped to (0, 1]."""
+    from flexflow_tpu.ops.attention import MultiHeadAttention
+    from flexflow_tpu.sim.cost_model import (TpuChipPerf, dtype_bytes,
+                                             param_byte_scale)
+
+    config = getattr(model, "config", None)
+    cm = AnalyticCostModel(param_scale=param_byte_scale(config))
+    perf = getattr(cm, "perf", None) or TpuChipPerf()
+    strategy = strategy if strategy is not None \
+        else getattr(config, "strategies", None)
+    machine = getattr(model, "machine", None)
+    kv_elem = dtype_bytes(getattr(config, "compute_dtype", "float32"))
+    full = dec = 0.0
+    for op in model.layers:
+        pc = strategy.get(op.name) if strategy is not None else None
+        if pc is None and machine is not None:
+            pc = machine.default_pc(max(len(op.output.shape), 1))
+        if pc is None:
+            continue
+        fwd = cm.op_cost(op, pc) / 3.0
+        shape = op.inputs[0].shape if op.inputs else ()
+        seq = int(shape[1]) if len(shape) >= 2 else 1
+        full += fwd
+        dec += fwd / max(seq, 1)
+        if isinstance(op, MultiHeadAttention):
+            dims = tuple(pc.dims) + (1,) * (3 - len(pc.dims))
+            s_p, h_p, n_p = int(dims[0]), int(dims[1]), int(dims[2])
+            batch = int(shape[0]) if len(shape) >= 1 else 1
+            kv_shard = (2.0 * -(-batch // max(n_p, 1))
+                        * -(-op.num_heads // max(h_p, 1))
+                        * -(-seq // max(s_p, 1))
+                        * op.head_dim * kv_elem)
+            dec += kv_shard / (perf.hbm_bandwidth
+                               * perf.vector_efficiency)
+    if full <= 0.0:
+        return 1.0
+    return float(min(max(dec / full, 1e-6), 1.0))

@@ -104,6 +104,10 @@ def device_memory_report(model, strategy=None, machine=None, *,
     activation term drops to factor 1.0 — nothing is saved for a
     backward, only the live inter-op tiles — while ``kv_cache_bytes``
     (per device, from serve/kv_cache.py) is added as its own bucket.
+    Under disaggregated serving the ring cache lives on the DECODE
+    pool only, so verify/plan.py passes ``kv_cache_bytes=0`` when
+    vetting a prefill-phase strategy (``serve.phase == "prefill"``)
+    and the decode layout's bytes for the decode pool.
 
     Returns ``{"per_device": {dev: {params, opt, grads, activations,
     inputs, kv_cache, total}}, "capacity": bytes, "over": [(dev, total),

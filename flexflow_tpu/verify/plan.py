@@ -456,24 +456,35 @@ def plan_findings(model, strategy=None, machine=None, *,
                                           where_prefix=where_prefix))
 
     # a SERVING strategy (apps/search.py --serve stamps
-    # __predicted__.objective == "latency") is vetted forward-only: no
-    # optimizer state or gradient cotangents in the peak, activation
-    # factor 1.0, and the KV cache charged per device
+    # __predicted__.objective == "latency", or "decode" for a
+    # disaggregated decode pool) is vetted forward-only: no optimizer
+    # state or gradient cotangents in the peak, activation factor 1.0,
+    # and the KV cache charged per device.  Under disaggregation the
+    # cache is charged to the DECODE pool only: a prefill-phase
+    # strategy (serve.phase == "prefill") streams its K/V straight into
+    # the handoff export and holds no ring, so its HBM peak carries
+    # kv_bytes == 0.
     pred = getattr(strategy, "predicted", None) if strategy is not None \
         else None
-    serving = bool(pred) and pred.get("objective") == "latency"
+    serving = bool(pred) and pred.get("objective") in ("latency",
+                                                       "decode")
     kv_bytes = 0.0
+    serve_phase = ""
     if serving:
-        kv_bytes = float((pred.get("serve") or {})
-                         .get("kv_cache_bytes_per_device", 0.0))
-        if not kv_bytes:
-            from flexflow_tpu.serve.kv_cache import kv_cache_bytes
+        serve = pred.get("serve") or {}
+        serve_phase = serve.get("phase") or \
+            ("decode" if pred.get("objective") == "decode" else "")
+        if serve_phase != "prefill":
+            kv_bytes = float(serve.get("kv_cache_bytes_per_device",
+                                       0.0))
+            if not kv_bytes:
+                from flexflow_tpu.serve.kv_cache import kv_cache_bytes
 
-            batch = (pred.get("serve") or {}).get("max_batch") \
-                or getattr(getattr(model, "config", None),
-                           "batch_size", 1)
-            kv_bytes = float(kv_cache_bytes(model, batch,
-                                            strategy=strategy))
+                batch = serve.get("max_batch") \
+                    or getattr(getattr(model, "config", None),
+                               "batch_size", 1)
+                kv_bytes = float(kv_cache_bytes(model, batch,
+                                                strategy=strategy))
 
     mem = None
     if check_memory:
@@ -507,6 +518,8 @@ def plan_findings(model, strategy=None, machine=None, *,
     if serving:
         summary["serving"] = {"forward_only": True,
                               "kv_cache_bytes_per_device": kv_bytes}
+        if serve_phase:
+            summary["serving"]["phase"] = serve_phase
     if mem is not None:
         peak = max((b["total"] for b in mem["per_device"].values()),
                    default=0.0)

@@ -1,0 +1,686 @@
+"""Disaggregated prefill/decode serving: KV handoff export/import
+round-trips across differing shard grids, plan_kv_handoff pricing, the
+``decode`` search objective + decode_step_ratio, carried-token batcher
+semantics, the multi-replica router (bit-identical routed replies,
+TTFT/TPOT split regression, session affinity, kv_refetch, drain), the
+per-phase plan vet, the ``serve_handoff`` / ``kv_refetch`` /
+``router_summary`` obs records through report + summarize, and the
+router trace lanes (prefill span -> handoff flow arrow -> decode
+span)."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.serve.kv_cache import (KVCache, KVCacheLayout,
+                                         plan_kv_handoff)
+from flexflow_tpu.serve.loadgen import Request, patterned_requests
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _layout(machine, *, max_seq=16, heads=4, head_dim=8, layers=2,
+            batch=4, s_parts=1, h_parts=1, n_parts=1):
+    grid = {}
+    if s_parts > 1 or h_parts > 1 or n_parts > 1:
+        grid = {"s_parts": s_parts, "h_parts": h_parts,
+                "n_parts": n_parts}
+    return KVCacheLayout(num_layers=layers, num_heads=heads,
+                         head_dim=head_dim, max_seq=max_seq,
+                         max_batch=batch, **grid)
+
+
+def _fill(cache, slot, n, seed=0):
+    """Write ``n`` sequential positions into one slot (one row per
+    step, the decode write path) and return the logical (k, v)."""
+    rng = np.random.RandomState(seed)
+    ks, vs = [], []
+    for li in range(cache.layout.num_layers):
+        k = rng.randn(n, cache.layout.num_heads,
+                      cache.layout.head_dim).astype(np.float32)
+        v = rng.randn(n, cache.layout.num_heads,
+                      cache.layout.head_dim).astype(np.float32)
+        ks.append(k)
+        vs.append(v)
+    for pos in range(n):
+        for li in range(cache.layout.num_layers):
+            cache.write(li, slot, pos, ks[li][pos], vs[li][pos])
+    return ks, vs
+
+
+# ---------------------------------------------------------------------------
+# KV handoff: export / import
+
+
+class TestKVHandoff:
+    def test_roundtrip_bit_exact_across_grids(self, machine8):
+        """Exported rows re-ring bit-exactly under a DIFFERENT
+        (s, h, n) shard grid — the prefill pool's layout never has to
+        match the decode pool's."""
+        src = KVCache(_layout(machine8, s_parts=2, h_parts=2))
+        dst = KVCache(_layout(machine8, h_parts=4, n_parts=2))
+        ks, vs = _fill(src, 1, 7)
+        payload = src.export_request(1)
+        assert payload is not None and payload["length"] == 7
+        got = dst.import_request(2, payload)
+        assert got == 7
+        for li in range(2):
+            k2, v2 = dst.read(li, 2)
+            np.testing.assert_array_equal(k2, ks[li])
+            np.testing.assert_array_equal(v2, vs[li])
+
+    def test_roundtrip_uneven_carveouts(self, machine8):
+        """Shard counts that do NOT divide the axis evenly (6 heads on
+        a 4-way head grid, 10-row window on a 3-way sequence grid)
+        still round-trip bit-exactly — export reads the logical order,
+        import re-rings under the destination's own carve."""
+        src = KVCache(_layout(machine8, max_seq=10, heads=6, s_parts=3))
+        dst = KVCache(_layout(machine8, max_seq=10, heads=6, h_parts=4))
+        ks, vs = _fill(src, 0, 9, seed=3)
+        got = dst.import_request(3, src.export_request(0))
+        assert got == 9
+        for li in range(2):
+            k2, v2 = dst.read(li, 3)
+            np.testing.assert_array_equal(k2, ks[li])
+            np.testing.assert_array_equal(v2, vs[li])
+
+    def test_roundtrip_wrapped_ring(self, machine8):
+        """A slot past its window (ring wrapped) exports only the kept
+        rows but preserves the LOGICAL length, so decode-side masks
+        keep pricing the true prefix."""
+        src = KVCache(_layout(machine8, max_seq=8))
+        dst = KVCache(_layout(machine8, max_seq=8, n_parts=2))
+        ks, vs = _fill(src, 0, 13, seed=1)
+        payload = src.export_request(0)
+        assert payload["length"] == 13 and payload["start"] == 5
+        assert dst.import_request(0, payload) == 13
+        for li in range(2):
+            k2, v2 = dst.read(li, 0)
+            np.testing.assert_array_equal(k2, ks[li][-8:])
+            np.testing.assert_array_equal(v2, vs[li][-8:])
+
+    def test_export_empty_and_import_validation(self, machine8):
+        src = KVCache(_layout(machine8))
+        assert src.export_request(0) is None
+        assert src.import_request(0, None) == 0
+        other = KVCache(_layout(machine8, heads=8))
+        _fill(src, 0, 3)
+        with pytest.raises(ValueError):
+            other.import_request(0, src.export_request(0))
+
+    def test_plan_kv_handoff_pricing(self, machine8):
+        src = _layout(machine8, s_parts=2)
+        dst = _layout(machine8, n_parts=2)
+        plan = plan_kv_handoff(src, dst, 7,
+                               src_topology=machine8.topology,
+                               dst_topology=machine8.topology)
+        # 2 (k+v) x layers x rows x heads x head_dim x 4B
+        assert plan["bytes"] == 2 * 2 * 7 * 4 * 8 * 4
+        # gather (src sharded) + cross-pool + scatter (dst sharded)
+        assert plan["hops"] == 3
+        assert plan["rows"] == 7
+        assert plan["predicted_s"] > 0
+        # unsharded -> unsharded is the single cross-pool hop
+        flat = plan_kv_handoff(_layout(machine8), _layout(machine8), 7)
+        assert flat["hops"] == 1
+        assert flat["predicted_s"] < plan["predicted_s"]
+        longer = plan_kv_handoff(src, dst, 14,
+                                 src_topology=machine8.topology,
+                                 dst_topology=machine8.topology)
+        assert longer["bytes"] == 2 * plan["bytes"]
+
+
+# ---------------------------------------------------------------------------
+# the decode search objective
+
+
+class TestDecodeObjective:
+    def test_objective_validation(self, machine8, tiny_lm_model):
+        from flexflow_tpu.sim.search import StrategySearch
+
+        with pytest.raises(ValueError, match="decode"):
+            StrategySearch(tiny_lm_model, machine8, objective="bogus")
+        s = StrategySearch(tiny_lm_model, machine8, objective="decode")
+        assert s.objective == "decode"
+
+    def test_decode_prices_below_latency(self, machine8, tiny_lm_model):
+        """A single-token decode step must price well under the full
+        forward (the per-token cost divides by seq; only the KV stream
+        rides on top)."""
+        from flexflow_tpu.sim.search import StrategySearch
+
+        lat = StrategySearch(tiny_lm_model, machine8,
+                             objective="latency")
+        dec = StrategySearch(tiny_lm_model, machine8,
+                             objective="decode")
+        _, li = lat.search(iters=30, seed=0)
+        _, di = dec.search(iters=30, seed=0)
+        assert di["best_time"] < li["best_time"]
+
+    def test_decode_step_ratio_deterministic(self, tiny_lm_model):
+        from flexflow_tpu.sim.search import decode_step_ratio
+
+        a = decode_step_ratio(tiny_lm_model)
+        b = decode_step_ratio(tiny_lm_model)
+        assert a == b
+        assert 0.0 < a <= 1.0
+        # the tiny GPT's decode step is far below its full forward
+        assert a < 0.5
+
+
+# ---------------------------------------------------------------------------
+# batcher: carried tokens + effective arrival
+
+
+class TestCarriedTokens:
+    def test_eff_arrival_orders_by_handoff(self):
+        from flexflow_tpu.serve.batcher import RequestQueue, _eff_arrival
+
+        early = Request(rid=1, arrival_v=0.0, tokens=np.array([2, 3]),
+                        max_new_tokens=2)
+        early.handoff_v = 5.0
+        late = Request(rid=2, arrival_v=1.0, tokens=np.array([2, 3]),
+                       max_new_tokens=2)
+        assert _eff_arrival(early) == 5.0 and _eff_arrival(late) == 1.0
+        q = RequestQueue([early, late])
+        assert q.next_arrival() == 1.0
+        assert [r.rid for r in q.pop_ready(2.0, 4)] == [2]
+        assert [r.rid for r in q.pop_ready(5.0, 4)] == [1]
+
+    def test_admit_preserves_stamps_and_carried(self):
+        from flexflow_tpu.serve.batcher import (ContinuousBatcher,
+                                                RequestQueue)
+
+        req = Request(rid=7, arrival_v=0.0, tokens=np.array([2, 3, 4]),
+                      max_new_tokens=4)
+        req.admit_v = 0.25          # stamped by the prefill pool
+        req.carried_tokens = [9]    # its first generated token
+        req.handoff_v = 1.0
+        b = ContinuousBatcher(max_batch=2, max_len=16)
+        q = RequestQueue([req])
+        idxs = b.admit(q, 2.0)
+        assert len(idxs) == 1
+        slot = b.slots[idxs[0]]
+        # queue-wait attribution stays with the user-facing admission
+        assert slot.req.admit_v == 0.25
+        # generated counts the carried token, so the decode pool never
+        # re-stamps first_token_v (TTFT belongs to the prefill pool)
+        assert slot.generated == 1
+        assert slot.tokens == [2, 3, 4, 9]
+
+    def test_release_frees_without_completion(self):
+        from flexflow_tpu.serve.batcher import (ContinuousBatcher,
+                                                RequestQueue)
+
+        req = Request(rid=1, arrival_v=0.0, tokens=np.array([2, 3]),
+                      max_new_tokens=2)
+        b = ContinuousBatcher(max_batch=1, max_len=8)
+        idx = b.admit(RequestQueue([req]), 0.0)[0]
+        slot = b.release(idx)
+        assert slot is not None and slot.req.done_v is None
+        assert b.num_active() == 0
+
+
+# ---------------------------------------------------------------------------
+# router unit semantics (no engine run)
+
+
+class TestRouterUnits:
+    def test_affinity_eviction_refetch(self, machine8, disagg_engines):
+        """LRU residency: the oldest session's rows evict at the cap;
+        its next follow-up is an explicit kv_refetch, not a silent
+        re-route."""
+        from flexflow_tpu.serve.router import ServeRouter
+
+        prefill, decode, _single = disagg_engines
+        router = ServeRouter(prefill, decode, log=lambda *a: None,
+                             residency_factor=1)
+        cap = router._residency_cap[0]
+
+        def follow_up(rid, sid):
+            r = Request(rid=rid, arrival_v=0.0,
+                        tokens=np.array([2, 3]), max_new_tokens=2)
+            r.session = sid
+            return r
+
+        first = router._route_decode(follow_up(0, 1000))
+        assert router._route_decode(follow_up(1, 1000)) == first
+        assert router.affinity_hits == 1
+        for i in range(cap):  # push 1000 out of the residency window
+            router._route_decode(follow_up(10 + i, 2000 + i))
+        assert 1000 not in router._residency[first]
+        router._route_decode(follow_up(99, 1000))
+        assert router.kv_refetches == 1
+
+    def test_phase_validation(self, machine8, disagg_engines):
+        from flexflow_tpu.serve.router import ServeRouter
+
+        prefill, decode, single = disagg_engines
+        with pytest.raises(ValueError):
+            ServeRouter(decode, decode, log=lambda *a: None)
+        with pytest.raises(ValueError):
+            ServeRouter(prefill, [single], log=lambda *a: None)
+        with pytest.raises(ValueError):
+            ServeRouter([], decode, log=lambda *a: None)
+
+
+# ---------------------------------------------------------------------------
+# router end-to-end (engine runs — the expensive half)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm_model(machine8):
+    from flexflow_tpu.apps.serve import _build_lm
+
+    model, _ = _build_lm(machine8, batch=8, seed=0, tiny=True,
+                         research_budget_s=0.5)
+    return model
+
+
+@pytest.fixture(scope="module")
+def disagg_engines(machine8, tiny_lm_model):
+    """Two 2-device prefill replicas + one 4-device decode pool (the
+    disagg-smoke geometry) plus the 8-device single-pool reference."""
+    from flexflow_tpu.apps.serve import _build_lm
+    from flexflow_tpu.serve.engine import (DEFAULT_STEP_TIME_S,
+                                           ServeEngine)
+    from flexflow_tpu.sim.search import decode_step_ratio
+
+    prefill = []
+    for j in range(2):
+        m = machine8.shrink([2 * j, 2 * j + 1])
+        model, _ = _build_lm(m, batch=2, seed=0, tiny=True)
+        prefill.append(ServeEngine(model, None, log=lambda *a: None,
+                                   step_time_s=DEFAULT_STEP_TIME_S,
+                                   phase="prefill"))
+    dm = machine8.shrink([4, 5, 6, 7])
+    dmodel, _ = _build_lm(dm, batch=4, seed=0, tiny=True)
+    decode = [ServeEngine(
+        dmodel, None, log=lambda *a: None,
+        step_time_s=DEFAULT_STEP_TIME_S * decode_step_ratio(dmodel),
+        phase="decode")]
+    single = ServeEngine(tiny_lm_model, None, log=lambda *a: None,
+                         step_time_s=DEFAULT_STEP_TIME_S)
+    return prefill, decode, single
+
+
+def _session_load():
+    return patterned_requests(12, seed=0, rate_qps=50.0,
+                              pattern="session", vocab_size=64,
+                              prompt_len=6, max_new_tokens=4)
+
+
+class TestRouterEndToEnd:
+    def test_routed_bit_identical_and_ttft_split(self, disagg_engines):
+        """The tentpole invariant: disaggregation changes WHERE tokens
+        decode, never WHAT decodes — plus the TTFT/TPOT regression pin:
+        the prefill pool stamps first_token_v (TTFT = one full-forward
+        step for unqueued requests) while the decode pool's cheaper
+        step sets TPOT."""
+        from flexflow_tpu.serve.engine import DEFAULT_STEP_TIME_S
+        from flexflow_tpu.serve.router import ServeRouter
+
+        prefill, decode, single = disagg_engines
+        router = ServeRouter(prefill, decode, log=lambda *a: None)
+        reqs = _session_load()
+        summary = router.run(reqs)
+        routed = {r.rid: list(r.reply) for r in reqs}
+
+        sreqs = _session_load()
+        ssum = single.run(sreqs)
+        expected = {r.rid: list(r.reply) for r in sreqs}
+        assert routed == expected
+        assert summary["completed"] == 12 and summary["unserved"] == 0
+        assert summary["handoffs"] == 12
+        assert summary["affinity_hits"] >= 1
+        assert summary["kv_refetches"] == 0
+        assert summary["pools"]["prefill"]["replicas"] == 2
+        assert summary["pools"]["decode"]["devices"] == 4
+
+        # TTFT is stamped by the PREFILL pool: an unqueued request's
+        # first token lands one full-forward step after admission
+        min_ttft = min(r.ttft_s for r in reqs)
+        assert min_ttft == pytest.approx(DEFAULT_STEP_TIME_S)
+        # TPOT is the decode pool's cheaper step (+ the priced handoff
+        # gap amortized over the tail) — strictly under the single
+        # pool's full-forward TPOT
+        decode_step = decode[0].step_time_s
+        tpots = [r.tpot_s for r in reqs if r.tpot_s is not None]
+        stpots = [r.tpot_s for r in sreqs if r.tpot_s is not None]
+        assert max(tpots) < min(stpots)
+        assert min(tpots) == pytest.approx(decode_step, rel=0.5)
+        assert summary["ttft_p50_s"] <= ssum["ttft_p50_s"] * 1.5
+
+    def test_drain_contract(self, machine8):
+        """Mid-run drain: arrivals stop, queued prefill work is
+        unserved, in-flight prefills hand off and decode to
+        completion."""
+        from flexflow_tpu.apps.serve import _DrainAfter, _build_lm
+        from flexflow_tpu.serve.engine import (DEFAULT_STEP_TIME_S,
+                                               ServeEngine)
+        from flexflow_tpu.serve.router import ServeRouter
+
+        m = machine8.shrink([0, 1])
+        pmodel, _ = _build_lm(m, batch=2, seed=0, tiny=True)
+        dmodel, _ = _build_lm(machine8.shrink([2, 3]), batch=2, seed=0,
+                              tiny=True)
+        router = ServeRouter(
+            [ServeEngine(pmodel, None, log=lambda *a: None,
+                         step_time_s=DEFAULT_STEP_TIME_S,
+                         phase="prefill")],
+            [ServeEngine(dmodel, None, log=lambda *a: None,
+                         step_time_s=DEFAULT_STEP_TIME_S,
+                         phase="decode")],
+            log=lambda *a: None)
+        summary = router.run(_session_load(), drain=_DrainAfter(3))
+        assert summary["drained"]
+        assert summary["unserved"] >= 1
+        assert summary["completed"] + summary["unserved"] == 12
+
+
+# ---------------------------------------------------------------------------
+# per-phase plan vet
+
+
+class TestPhasePlanVet:
+    def test_prefill_phase_charges_no_kv(self, machine8, tiny_lm_model):
+        from flexflow_tpu.strategy import Strategy
+        from flexflow_tpu.verify.plan import plan_findings
+
+        strat = Strategy()
+        strat.predicted = {"objective": "latency",
+                           "serve": {"phase": "prefill",
+                                     "max_batch": 8}}
+        _, summary = plan_findings(tiny_lm_model, strat, machine8)
+        assert summary["serving"]["phase"] == "prefill"
+        assert summary["serving"]["kv_cache_bytes_per_device"] == 0.0
+
+    def test_decode_objective_implies_decode_phase(self, machine8,
+                                                   tiny_lm_model):
+        from flexflow_tpu.strategy import Strategy
+        from flexflow_tpu.verify.plan import plan_findings
+
+        strat = Strategy()
+        strat.predicted = {"objective": "decode",
+                           "serve": {"max_batch": 8}}
+        _, summary = plan_findings(tiny_lm_model, strat, machine8)
+        assert summary["serving"]["phase"] == "decode"
+        assert summary["serving"]["kv_cache_bytes_per_device"] > 0
+
+
+# ---------------------------------------------------------------------------
+# session arrival pattern
+
+
+class TestSessionPattern:
+    def test_deterministic_and_sorted(self):
+        a = _session_load()
+        b = _session_load()
+        assert [(r.rid, r.arrival_v, r.session) for r in a] \
+            == [(r.rid, r.arrival_v, r.session) for r in b]
+        assert all(a[i].arrival_v <= a[i + 1].arrival_v
+                   for i in range(len(a) - 1))
+        assert len(a) == 12
+
+    def test_follow_ups_share_session(self):
+        reqs = patterned_requests(40, seed=0, rate_qps=50.0,
+                                  pattern="session", session_turns=4.0)
+        by_sid = {}
+        for r in reqs:
+            assert r.session is not None
+            by_sid.setdefault(r.session, []).append(r)
+        multi = [v for v in by_sid.values() if len(v) > 1]
+        assert multi, "mean 4 turns must yield multi-turn sessions"
+        for turns in multi:
+            assert all(turns[i].arrival_v < turns[i + 1].arrival_v
+                       for i in range(len(turns) - 1))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            patterned_requests(4, pattern="session", session_turns=0.5)
+        with pytest.raises(ValueError):
+            patterned_requests(4, pattern="session",
+                               session_think_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# obs: records through report, trace lanes
+
+
+def _handoff_records():
+    """A hand-built routed-request obs stream: queue wait 0 -> 0.01,
+    prefill 0.01 -> 0.02, handoff lands 0.021, decode tail to 0.04."""
+    return [
+        {"kind": "serve_request", "rid": 1, "arrival_v": 0.0,
+         "admit_v": 0.01, "first_token_v": 0.02, "done_v": 0.04,
+         "latency_s": 0.04, "ttft_s": 0.02, "tpot_s": 0.00667,
+         "prompt_len": 4, "new_tokens": 4, "pool": "decode"},
+        {"kind": "serve_handoff", "rid": 1, "session": 5,
+         "from_replica": 0, "to_replica": 0, "bytes": 4096, "hops": 1,
+         "predicted_s": 0.001, "rows": 4, "handoff_v": 0.021,
+         "carried": 1},
+        {"kind": "serve_batch", "step": 1, "vnow": 0.02, "active": 1,
+         "admitted": 1, "queue_depth": 0, "devices": 2,
+         "pool": "prefill", "step_time_s": 0.01, "kv_tokens": 4,
+         "kv_frac": 0.1},
+        {"kind": "serve_batch", "step": 1, "vnow": 0.04, "active": 1,
+         "admitted": 1, "queue_depth": 0, "devices": 4,
+         "pool": "decode", "step_time_s": 0.000631, "kv_tokens": 5,
+         "kv_frac": 0.12},
+        {"kind": "kv_refetch", "rid": 9, "session": 5,
+         "old_replica": 0},
+        {"kind": "router_summary", "requests": 1, "completed": 1,
+         "unserved": 0, "dropped": 0, "qps": 25.0, "p50_s": 0.04,
+         "p99_s": 0.04, "ttft_p50_s": 0.02, "ttft_p99_s": 0.02,
+         "tpot_p50_s": 0.00667, "tpot_p99_s": 0.00667, "steps": 2,
+         "resizes": 0, "virtual_s": 0.04, "drained": False,
+         "devices": 6, "handoffs": 1, "affinity_hits": 0,
+         "kv_refetches": 1,
+         "pools": {"prefill": {"replicas": 1, "devices": 2,
+                               "steps": 1, "completed": 0},
+                   "decode": {"replicas": 1, "devices": 4,
+                              "steps": 1, "completed": 1}}},
+    ]
+
+
+class TestDisaggObs:
+    def test_trace_router_lanes(self):
+        from flexflow_tpu.obs.trace import (chrome_trace,
+                                            serve_trace_events,
+                                            validate_trace)
+
+        evs = serve_trace_events(_handoff_records())
+        assert validate_trace(chrome_trace(evs)) == []
+        by_cat = {}
+        for e in evs:
+            by_cat.setdefault(e.get("cat"), []).append(e)
+        # the routed lifecycle: queue -> prefill span -> handoff flow
+        # arrow (s at first token, f at the priced landing) -> decode
+        assert len(by_cat["queue"]) == 1
+        (pf,) = by_cat["prefill"]
+        assert pf["ph"] == "X" and pf["dur"] > 0
+        hs, hf = sorted(by_cat["handoff"], key=lambda e: e["ts"])
+        assert (hs["ph"], hf["ph"]) == ("s", "f")
+        assert hs["id"] == hf["id"] and hs["id"] >= 1_000_000
+        assert hf["ts"] > hs["ts"]
+        (dec,) = by_cat["decode"]
+        assert dec["ts"] == pytest.approx(hf["ts"])
+        assert dec["args"]["to_replica"] == 0
+        # per-pool counter tracks
+        counters = {e["name"] for e in evs if e.get("ph") == "C"}
+        assert "queue depth [prefill]" in counters
+        assert "KV cache [decode]" in counters
+
+    def test_report_and_summarize(self, tmp_path):
+        from flexflow_tpu import obs
+        from flexflow_tpu.apps.report import serve_main
+        from flexflow_tpu.obs.report import summarize
+
+        olog = obs.RunLog(str(tmp_path / "r.jsonl"), surface="serve")
+        for rec in _handoff_records():
+            olog.event(rec["kind"],
+                       **{k: v for k, v in rec.items() if k != "kind"})
+        olog.close()
+        events = list(obs.read_run(olog.path))
+        rendered = []
+        rc = serve_main([olog.path], log=lambda m: rendered.append(m))
+        text = "\n".join(rendered)
+        assert rc == 0
+        assert "pool[prefill]" in text and "pool[decode]" in text
+        assert "handoffs: 1 prefill->decode" in text
+        assert "1 kv_refetch(es)" in text
+        assert "router: 1/1 served" in text
+
+        sv = summarize(events)["serve"]
+        assert sv["handoffs"] == {"n": 1, "bytes": 4096,
+                                  "kv_refetches": 1}
+        assert sv["router"]["pools"]["decode"]["devices"] == 4
+
+
+# ---------------------------------------------------------------------------
+# fleet: per-phase demand tiers
+
+
+class TestFleetPhases:
+    def test_jobspec_serve_phase_validation(self):
+        from flexflow_tpu.fleet.job import JobSpec
+
+        ok = JobSpec(job_id="d", kind="serve", build=None, config=None,
+                     serve_phase="decode")
+        assert ok.serve_phase == "decode"
+        with pytest.raises(ValueError):
+            JobSpec(job_id="t", kind="train", build=None, config=None,
+                    serve_phase="decode")
+        with pytest.raises(ValueError):
+            JobSpec(job_id="b", kind="serve", build=None, config=None,
+                    serve_phase="bogus")
+
+    def test_arbiter_objective_per_phase(self):
+        from flexflow_tpu.fleet.arbiter import Arbiter
+        from flexflow_tpu.fleet.job import JobSpec
+
+        def obj(kind, phase=""):
+            return Arbiter._objective_for(
+                JobSpec(job_id="x", kind=kind, build=None, config=None,
+                        serve_phase=phase))
+
+        assert obj("serve", "decode") == "decode"
+        assert obj("serve", "prefill") == "latency"
+        assert obj("serve") == "latency"
+        assert obj("train") == "makespan"
+
+
+# ---------------------------------------------------------------------------
+# drivers: flags, carve, artifact
+
+
+class TestDriverPlumbing:
+    def test_config_disagg_flags(self):
+        from flexflow_tpu.config import FFConfig
+
+        cfg = FFConfig.from_args([
+            "--serve-prefill-devices", "4",
+            "--serve-prefill-replicas", "2",
+            "--serve-decode-replicas", "2"])
+        assert cfg.serve_prefill_devices == 4
+        assert cfg.serve_prefill_replicas == 2
+        assert cfg.serve_decode_replicas == 2
+
+    def test_serve_parse_args(self):
+        from flexflow_tpu.apps.serve import parse_args
+
+        opts = parse_args(["gpt", "--serve-prefill-devices", "2",
+                           "--serve-prefill-replicas", "2",
+                           "--serve-decode-replicas", "1",
+                           "--disagg-smoke"])
+        assert opts["prefill_devices"] == 2
+        assert opts["prefill_replicas"] == 2
+        assert opts["decode_replicas"] == 1
+        assert opts["disagg_smoke"]
+
+    def test_search_parse_args_disagg(self):
+        from flexflow_tpu.apps.search import parse_args
+
+        opts = parse_args(["gpt", "--serve", "--disagg", "4"])
+        assert opts["serve"] and opts["disagg"] == 4
+        assert opts["objective"] == "latency"
+        opts = parse_args(["gpt", "--objective", "decode"])
+        assert opts["objective"] == "decode"
+        with pytest.raises(SystemExit):
+            parse_args(["gpt", "--objective", "bogus"])
+
+    def test_loadtest_carve(self):
+        from flexflow_tpu.apps.loadtest import _disagg_carve, parse_args
+
+        assert _disagg_carve(2) == {
+            "prefill_devices": 1, "decode_devices": 1,
+            "prefill_replicas": 1, "per_replica_devices": 1}
+        assert _disagg_carve(8) == {
+            "prefill_devices": 4, "decode_devices": 4,
+            "prefill_replicas": 2, "per_replica_devices": 2}
+        opts = parse_args(["--disagg", "--baseline", "X.json"])
+        assert opts["disagg"] and opts["baseline"] == "X.json"
+
+    def test_disagg_run_rejects_pool_wide_plan(self, machine8):
+        """A prefill plan searched at the WHOLE pool size cannot drive
+        per-replica slices — the driver must say so instead of failing
+        deep inside strategy validation."""
+        from flexflow_tpu import obs
+        from flexflow_tpu.apps.serve import _disagg_run, parse_args
+        from flexflow_tpu.strategy import ParallelConfig, Strategy
+
+        opts = parse_args(["gpt", "--tiny",
+                           "--serve-prefill-devices", "4",
+                           "--serve-prefill-replicas", "2"])
+        strat = Strategy({"embed": ParallelConfig(
+            dims=(4,), devices=(0, 1, 2, 3))})
+        with pytest.raises(SystemExit, match="per-replica"):
+            _disagg_run(opts, machine8, strat, obs.NULL, None,
+                        lambda *a: None)
+
+    def test_vs_baseline_artifact(self, tmp_path):
+        from flexflow_tpu.apps.loadtest import _vs_baseline_artifact
+
+        base = {"schema": "serve_bench_v1",
+                "sweep": [{"devices": 2, "ttft_p99_s": 0.4,
+                           "p99_s": 0.5, "goodput_qps": 100.0,
+                           "slo_compliant": False}]}
+        p = tmp_path / "SERVE_r01.json"
+        p.write_text(json.dumps(base))
+        sweep = [{"devices": 2, "ttft_p99_s": 0.2, "p99_s": 0.25,
+                  "goodput_qps": 150.0, "slo_compliant": True}]
+        vs = _vs_baseline_artifact(sweep, str(p), lambda *a: None)
+        pt = vs["points"]["2"]
+        assert pt["ttft_p99_speedup"] == pytest.approx(2.0)
+        assert pt["goodput_ratio"] == pytest.approx(1.5)
+        assert vs["baseline"] == "SERVE_r01.json"
+        missing = _vs_baseline_artifact(sweep, str(tmp_path / "nope"),
+                                        lambda *a: None)
+        assert missing is None
+
+    def test_committed_serve_r02_artifact(self):
+        """The headline artifact: same traffic spec as SERVE_r01, and a
+        measured TTFT-p99 + goodput win at the 2- and 4-device points
+        (the ISSUE's acceptance bar)."""
+        r02_path = os.path.join(REPO_ROOT, "SERVE_r02.json")
+        r01_path = os.path.join(REPO_ROOT, "SERVE_r01.json")
+        if not (os.path.exists(r02_path) and os.path.exists(r01_path)):
+            pytest.skip("committed artifacts not present")
+        with open(r02_path) as f:
+            r02 = json.load(f)
+        with open(r01_path) as f:
+            r01 = json.load(f)
+        assert r02["schema"] == "serve_bench_v1" and r02["disagg"]
+        for k in ("seed", "pattern", "requests_per_point", "rate_qps",
+                  "slots_per_device", "slo"):
+            assert r02[k] == r01[k], f"traffic spec drift on {k}"
+        for dev in ("2", "4"):
+            pt = r02["vs_r01"]["points"][dev]
+            assert pt["ttft_p99_speedup"] > 1.0
+            assert pt["goodput_ratio"] > 1.0
+        for p in r02["sweep"]:
+            assert math.isfinite(p["ttft_p99_s"])
+            assert p["handoffs"] > 0

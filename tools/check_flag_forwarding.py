@@ -65,6 +65,12 @@ SERVE_ONLY = {
     "max_batch": "continuous-batching decode slots (apps/serve.py)",
     "serve_queue_hi": "autoscale grow watermark (apps/serve.py)",
     "serve_idle_boundaries": "autoscale shrink watermark (apps/serve.py)",
+    "serve_prefill_devices":
+        "disaggregated prefill-pool carve (serve/router.py)",
+    "serve_prefill_replicas":
+        "prefill replicas behind the router (serve/router.py)",
+    "serve_decode_replicas":
+        "decode replicas behind the router (serve/router.py)",
 }
 
 # FFConfig fields that belong to the FLEET coordinator (apps/fleet.py
