@@ -3,7 +3,7 @@ PYTHON ?= python
 
 .PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
 	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke \
-	serve-smoke fleet-smoke loadtest-smoke disagg-smoke
+	serve-smoke fleet-smoke loadtest-smoke disagg-smoke fleetsim-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -17,7 +17,7 @@ native:
 # every emitted obs record kind must be rendered by obs/report.py and
 # covered by a test (tools/check_obs_kinds.py), and the static strategy
 # verifier must come up clean (lint)
-check: lint fusion-smoke serve-smoke disagg-smoke fleet-smoke loadtest-smoke
+check: lint fusion-smoke serve-smoke disagg-smoke fleet-smoke loadtest-smoke fleetsim-smoke
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
@@ -246,6 +246,41 @@ fleet-smoke:
 	print('fleet-smoke ok:', {k: rec[k] for k in \
 	('jobs','done','rebalances','packs','native_prices', \
 	'train_final_loss','serve_completed')})"
+
+# trace-driven fleet-simulation smoke (round 18, jax-free): a seeded
+# day of synthetic jobs through the REAL coordinator/arbiter in
+# virtual time — asserts one JSON stdout line, the first sweep point
+# bit-identical across two in-process runs (repro), the fleet_util
+# device-second invariant upheld at EVERY round of every point
+# (util_violations == 0 or the harness itself exits non-zero), a
+# validated lifecycle Perfetto trace, finite wait percentiles, and a
+# fleet_bench_v1 artifact matching the metric line
+fleetsim-smoke:
+	$(PYTHON) -m flexflow_tpu.apps.fleetsim --smoke \
+	--out /tmp/ff-fleetsim-smoke.json \
+	| $(PYTHON) -c "import json,math,sys; \
+	rec=json.loads(sys.stdin.readline()); \
+	assert sys.stdin.readline() == '', 'stdout must be one JSON line'; \
+	assert all(k in rec for k in \
+	('metric','value','unit','vs_baseline')), rec; \
+	assert rec['unit'] == 'frac', rec; \
+	assert 0.0 < rec['value'] <= 1.0, rec; \
+	assert rec['repro'] is True, rec; \
+	assert rec['util_violations'] == 0, rec; \
+	assert rec['trace_validated'] is True, rec; \
+	assert all(math.isfinite(rec[k]) for k in \
+	('value','wait_p50_s','wait_p99_s')), rec; \
+	art=json.load(open(rec['out'])); \
+	assert art['schema'] == 'fleet_bench_v1', art; \
+	assert art['parsed']['metric'] == rec['metric'] \
+	and art['parsed']['value'] == rec['value'], art['parsed']; \
+	assert len(art['points']) == rec['sweep_points'] >= 2, art; \
+	assert all(p['util_violations'] == 0 for p in art['points']), art; \
+	assert all(p['jobs_done'] + p['jobs_failed'] <= p['jobs'] \
+	for p in art['points']), art; \
+	print('fleetsim-smoke ok:', {k: rec[k] for k in \
+	('metric','value','vs_baseline','sweep_points','wait_p99_s', \
+	'rebalances','repro','trace_validated')})"
 
 # MFU-waterfall smoke (observability): tiny CNN with sampled op timing +
 # live metrics export; asserts the step_budget bucket invariant, a

@@ -229,13 +229,41 @@ def smoke(cfg, log=_err):
                    "elastic_resize"] * 2, f"merged sequence: {seq}"
     kinds = {e["kind"] for e in fleet_events}
     assert {"fleet_job", "fleet_placement", "fleet_rebalance",
-            "fleet_summary"} <= kinds, kinds
+            "fleet_summary", "fleet_util"} <= kinds, kinds
+
+    # utilization attribution: EVERY fleet_util round satisfies the
+    # exact busy+idle+resizing == pool capacity x span invariant
+    from flexflow_tpu.fleet import check_fleet_util
+
+    util_recs = [e for e in fleet_events if e["kind"] == "fleet_util"]
+    assert util_recs, "no fleet_util rounds recorded"
+    for rec in util_recs:
+        violations = check_fleet_util(rec)
+        assert not violations, f"fleet_util invariant: {violations}"
+    assert any(rec["busy_steps"] > 0 for rec in util_recs), \
+        "no busy device-steps accounted across the whole run"
+
+    # wait attribution: both jobs carry a finite fleet_wait
+    # decomposition whose buckets sum to the total
+    waits = {e["job"]: e for e in a_events + b_events
+             if e["kind"] == "fleet_wait"}
+    assert set(waits) == {"train-a", "serve-b"}, set(waits)
+    for jid, w in waits.items():
+        parts = [w["wait_s"], w["placement_s"], w["run_s"],
+                 w["drain_s"], w["resize_s"]]
+        assert all(math.isfinite(v) and v >= 0 for v in parts), w
+        assert math.isfinite(w["total_s"]) and w["total_s"] > 0, w
+        assert abs(sum(parts) - w["total_s"]) < 1e-9, w
+        # both jobs were resized mid-run: drain+resize time is real
+        assert w["drain_s"] > 0 and w["resize_s"] > 0, w
 
     # mixed-stream summarize (satellite: multi-job obs tolerance)
     from flexflow_tpu.obs.report import summarize
 
     s = summarize(merged)
     assert s.get("fleet", {}).get("rebalances") == 2, s.get("fleet")
+    assert len(s["fleet"].get("waits", [])) == 2, s["fleet"]
+    assert s["fleet"].get("util", {}).get("busy_steps", 0) > 0
 
     # packing reproducibility: a second arbiter under the same seed,
     # pricing from scratch, must choose the identical initial packing

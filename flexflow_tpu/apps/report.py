@@ -9,7 +9,10 @@
         [--json] [--trace OUT.trace.json]
     python -m flexflow_tpu.apps.report slo <run.jsonl|obs_dir ...> \\
         [--target-s X] [--availability Y] [--window-s W] \\
-        [--percentile P] [--json]
+        [--percentile P] [--kind K] [--latency-field F] \\
+        [--time-field T] [--json]
+    python -m flexflow_tpu.apps.report fleet <run.jsonl|obs_dir ...> \\
+        [--json] [--trace OUT.trace.json]
 
 Default mode renders a run's JSONL event stream (FFConfig.obs_dir /
 RunLog output, a search-trace artifact, or a bench log) into the summary
@@ -55,7 +58,17 @@ records), validated before writing.
 The ``slo`` subcommand evaluates a latency SLO over the stream's
 ``serve_request`` records (obs/slo.py): whole-stream and worst-window
 error-budget burn rate, achieved percentile, goodput-under-SLO.  Exit 1
-when the stream has no completed requests.
+when the stream has no completed requests.  ``--kind`` /
+``--latency-field`` retarget the same math, e.g. a wait-time SLO over
+a fleet stream's ``fleet_wait`` records (``--kind fleet_wait
+--latency-field wait_s``).
+
+The ``fleet`` subcommand renders a fleet run's ``fleet_*`` records
+(apps/fleet.py / apps/fleetsim.py): per-job lifecycle trails and wait
+decompositions, packings and rebalances, the device-second
+utilization account (with its exact busy+idle+resizing == capacity
+invariant re-checked), and fleetsim sweep points.  ``--trace``
+exports the lifecycle/flow/pool-util Perfetto lanes.
 """
 
 from __future__ import annotations
@@ -355,13 +368,85 @@ def serve_main(argv, log=print) -> int:
     return 0
 
 
+def fleet_main(argv, log=print) -> int:
+    """The fleet pass (``report fleet``): render a coordinator run's
+    ``fleet_*`` records — per-job lifecycle trails, wait
+    decompositions (``fleet_wait``), packings, rebalances, the
+    device-second utilization account (``fleet_util``, validated
+    against its exact busy+idle+resizing == capacity invariant), and
+    fleetsim sweep points.  ``--trace OUT.trace.json`` exports the
+    per-job lifecycle lanes + rebalance flow arrows + pool-util
+    counters, validated before writing.  Exit 1 when the stream
+    carries no fleet records or a ``fleet_util`` record violates the
+    invariant."""
+    from flexflow_tpu.fleet.coordinator import check_fleet_util
+    from flexflow_tpu.obs.report import _fleet_section, summarize
+
+    json_out = "--json" in argv
+    trace_out = None
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--trace":
+            i += 1
+            if i >= len(argv):
+                raise SystemExit("flag '--trace' expects a value")
+            trace_out = argv[i]
+        elif not a.startswith("-"):
+            paths.append(a)
+        i += 1
+    if not paths:
+        log(fleet_main.__doc__.strip())
+        return 2
+    events, _ = _read_paths(paths, log)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    violations = []
+    for e in events:
+        if e.get("kind") == "fleet_util":
+            violations += check_fleet_util(e)
+    if trace_out:
+        from flexflow_tpu.obs import trace as obstrace
+
+        trace = obstrace.chrome_trace(obstrace.fleet_trace_events(events))
+        errors = obstrace.validate_trace(trace)
+        if errors:
+            for e in errors:
+                log(f"trace invalid: {e}")
+            return 1
+        obstrace.write_trace(trace_out, trace)
+        log(f"written: {trace_out} "
+            f"({len(trace['traceEvents'])} events; open in "
+            f"ui.perfetto.dev)")
+    if json_out:
+        s = summarize(events)
+        out = {k: s[k] for k in ("fleet", "fleetsim") if k in s}
+        if violations:
+            out["util_violations"] = violations
+        log(json.dumps(out))
+        return 0 if out and not violations else 1
+    lines = _fleet_section(events)
+    if not lines:
+        log("no fleet_* records in the stream(s): run apps/fleet.py "
+            "or apps/fleetsim.py with -obs-dir set")
+        return 1
+    log("\n".join(lines))
+    if violations:
+        log("FLEET_UTIL INVARIANT VIOLATED: " + "; ".join(violations))
+        return 1
+    return 0
+
+
 def slo_main(argv, log=print) -> int:
     """The SLO pass (``report slo``): evaluate a latency SLO over the
     stream's ``serve_request`` records — whole-stream + worst-window
     error-budget burn rate, achieved percentile, goodput-under-SLO.
     Spec via ``--target-s`` / ``--availability`` / ``--window-s`` /
-    ``--percentile``.  Exit 1 when the stream has no completed
-    requests."""
+    ``--percentile``.  ``--kind`` / ``--latency-field`` /
+    ``--time-field`` retarget the same burn-rate math at another
+    record family (e.g. a wait-time SLO over a fleet stream:
+    ``--kind fleet_wait --latency-field wait_s``).  Exit 1 when the
+    stream has no completed requests."""
     from flexflow_tpu.obs.slo import SLOSpec, burn_rate_windows, evaluate
 
     json_out = "--json" in argv
@@ -371,16 +456,23 @@ def slo_main(argv, log=print) -> int:
              "--window-s": ("window_s", float),
              "--percentile": ("percentile", float),
              "--name": ("name", str)}
+    stream_kw = {"kind": "serve_request", "latency_field": "latency_s",
+                 "time_field": "done_v"}
+    stream_flags = {"--kind": "kind", "--latency-field": "latency_field",
+                    "--time-field": "time_field"}
     paths = []
     i = 0
     while i < len(argv):
         a = argv[i]
-        if a in flags:
+        if a in flags or a in stream_flags:
             i += 1
             if i >= len(argv):
                 raise SystemExit(f"flag {a!r} expects a value")
-            key, cast = flags[a]
-            spec_kw[key] = cast(argv[i])
+            if a in flags:
+                key, cast = flags[a]
+                spec_kw[key] = cast(argv[i])
+            else:
+                stream_kw[stream_flags[a]] = argv[i]
         elif not a.startswith("-"):
             paths.append(a)
         i += 1
@@ -390,13 +482,15 @@ def slo_main(argv, log=print) -> int:
     spec = SLOSpec(**spec_kw)
     events, _ = _read_paths(paths, log)
     events.sort(key=lambda e: e.get("ts", 0.0))
-    result = evaluate(events, spec)
+    result = evaluate(events, spec, **stream_kw)
     if not result["total"]:
-        log("no completed serve_request records in the stream(s): run "
-            "apps/serve.py or apps/loadtest.py with -obs-dir set")
+        log(f"no completed {stream_kw['kind']} records in the "
+            f"stream(s): run apps/serve.py, apps/loadtest.py, or "
+            f"apps/fleetsim.py with -obs-dir set")
         return 1
     if json_out:
-        result["window_detail"] = burn_rate_windows(events, spec)
+        result["window_detail"] = burn_rate_windows(events, spec,
+                                                    **stream_kw)
         log(json.dumps(result))
         return 0
     s = result["spec"]
@@ -427,6 +521,8 @@ def main(argv=None, log=print) -> int:
         return serve_main(argv[1:], log)
     if argv and argv[0] == "slo":
         return slo_main(argv[1:], log)
+    if argv and argv[0] == "fleet":
+        return fleet_main(argv[1:], log)
     json_out = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:

@@ -25,16 +25,26 @@ This package builds the layer above:
     into the elastic machinery) so preemption is a routine economy, not
     a fault.
 
-Obs kinds: ``fleet_job`` (one per lifecycle transition),
+Obs kinds: ``fleet_job`` (one per lifecycle transition, vts-stamped),
 ``fleet_placement`` (one per arbiter packing), ``fleet_rebalance`` (one
-per executed re-packing), ``fleet_summary`` (one per coordinator run).
-Per-job streams live in ``obs_dir/<job_id>/`` so concurrent jobs never
-interleave one run file.  ``apps/fleet.py`` is the driver; ``make
-fleet-smoke`` is the deterministic two-jobs-trade-devices CPU scenario.
+per executed re-packing), ``fleet_wait`` (one per finished job: its
+life decomposed into wait/placement/run/drain/resize virtual seconds),
+``fleet_util`` (one per round: every device-step accounted busy/idle/
+resizing under the exact :func:`~flexflow_tpu.fleet.coordinator.
+check_fleet_util` invariant), ``fleet_summary`` (one per coordinator
+run).  Per-job streams live in ``obs_dir/<job_id>/`` so concurrent
+jobs never interleave one run file.  ``apps/fleet.py`` is the driver;
+``make fleet-smoke`` is the deterministic two-jobs-trade-devices CPU
+scenario, and ``apps/fleetsim.py`` (``make fleetsim-smoke``) is the
+trace-driven fleet simulation that benchmarks scheduler policy the way
+kernels are benchmarked (FLEET_r01.json).
 """
 
 from flexflow_tpu.fleet.arbiter import Arbiter
-from flexflow_tpu.fleet.coordinator import FleetCoordinator
+from flexflow_tpu.fleet.coordinator import (FleetCoordinator,
+                                            VirtualClock,
+                                            check_fleet_util)
 from flexflow_tpu.fleet.job import Job, JobSpec
 
-__all__ = ["Arbiter", "FleetCoordinator", "Job", "JobSpec"]
+__all__ = ["Arbiter", "FleetCoordinator", "Job", "JobSpec",
+           "VirtualClock", "check_fleet_util"]

@@ -136,6 +136,11 @@ class Arbiter:
         larger slices are never free."""
         return 1.0 / float(size) + 0.001 * float(size)
 
+    # the DP proxy as a PUBLIC injectable pricer: pass
+    # ``pricer=Arbiter.proxy_pricer`` to skip native pricing entirely
+    # (apps/fleetsim.py's no-jit CPU-fast mode — jax never loads)
+    proxy_pricer = _price_proxy
+
     def priced_strategy(self, job, size: int) -> Optional[object]:
         """The strategy the native pricing search found for this (job,
         size), if any — handed to ``Job.place`` so the job runs under

@@ -31,6 +31,25 @@ boring — determinism is the feature:
 Drain rides the same dict the elastic runtime uses: SIGTERM sets
 ``drain["requested"]``, every job winds down at its next boundary, and
 the driver exits 0 (the scheduler contract — see README "Elastic").
+
+**Virtual time + utilization accounting (round 18).**  The coordinator
+owns a :class:`VirtualClock` (one tick per quantum step) and attaches
+it to every admitted job, so lifecycle records are stamped in virtual
+time and each job's ``fleet_wait`` decomposition is exact.  Every round
+emits a ``fleet_util`` record that accounts EVERY device-step in the
+pool — busy (a running job executed a step on a held device), resizing
+(a placement or directed resize advanced the clock while devices were
+in motion), idle (the remainder) — under the budget.py-style provable
+invariant checked by :func:`check_fleet_util`:
+
+    busy_steps + idle_steps + resizing_steps == pool_devices x span_steps
+
+as EXACT integer equality, at every round.  The loop decomposes into
+public :meth:`FleetCoordinator.start` / :meth:`~FleetCoordinator.
+step_round` / :meth:`~FleetCoordinator.finish` so a driver
+(apps/fleetsim.py) can interleave mid-run admissions and
+:meth:`~FleetCoordinator.idle_advance` gaps between rounds;
+:meth:`~FleetCoordinator.run` composes them unchanged.
 """
 
 from __future__ import annotations
@@ -43,12 +62,73 @@ from flexflow_tpu.fleet.arbiter import Arbiter
 from flexflow_tpu.fleet.job import Job, JobSpec
 
 
+class VirtualClock:
+    """Integer step counter + seconds-per-step scale: the fleet's
+    virtual time base.  Jobs and the coordinator only ever ``advance``
+    by whole steps, so device-second accounting stays exact integer
+    arithmetic (``check_fleet_util``); ``now()`` is the float seconds
+    view the obs records carry."""
+
+    def __init__(self, step_time_s: float = 0.05, resize_steps: int = 1):
+        if step_time_s <= 0:
+            raise ValueError("step_time_s must be > 0")
+        self.step_time_s = float(step_time_s)
+        #: virtual steps one placement / one drain / one regrid costs
+        self.resize_steps = max(int(resize_steps), 1)
+        self.steps = 0
+
+    def now(self) -> float:
+        return self.steps * self.step_time_s
+
+    def advance(self, steps: int) -> None:
+        self.steps += max(int(steps), 0)
+
+
+def check_fleet_util(rec: Dict) -> List[str]:
+    """Violations of the fleet_util invariant (empty list = OK): the
+    three buckets are non-negative ints summing EXACTLY to pool
+    capacity x round span, and the derived seconds fields match
+    ``steps x step_time_s``.  The obs/budget.py ``check_budget``
+    contract, for device-seconds instead of step wall time."""
+    problems: List[str] = []
+    for k in ("pool_devices", "span_steps", "busy_steps", "idle_steps",
+              "resizing_steps"):
+        v = rec.get(k)
+        if not isinstance(v, int) or isinstance(v, bool):
+            problems.append(f"{k} must be an int, got {v!r}")
+        elif v < 0:
+            problems.append(f"{k} must be >= 0, got {v}")
+    if problems:
+        return problems
+    cap = rec["pool_devices"] * rec["span_steps"]
+    total = (rec["busy_steps"] + rec["idle_steps"]
+             + rec["resizing_steps"])
+    if total != cap:
+        problems.append(
+            f"buckets sum to {total} device-steps but pool capacity x "
+            f"round span is {cap} ({rec['pool_devices']} devices x "
+            f"{rec['span_steps']} steps)")
+    st = rec.get("step_time_s")
+    if isinstance(st, (int, float)) and not isinstance(st, bool) \
+            and st > 0:
+        for name in ("busy", "idle", "resizing"):
+            sec = rec.get(f"{name}_s")
+            want = rec[f"{name}_steps"] * st
+            if sec is not None and \
+                    abs(sec - want) > 1e-9 * max(1.0, abs(want)):
+                problems.append(
+                    f"{name}_s {sec} != {name}_steps x step_time_s "
+                    f"{want}")
+    return problems
+
+
 class FleetCoordinator:
     """Owns the pool, the jobs, and the rebalance economy."""
 
     def __init__(self, pool, *, obs_dir: str = "", olog=None,
                  metrics=None, quantum: int = 4, budget_s: float = 30.0,
                  iters: int = 200, seed: int = 0, pricer=None,
+                 step_time_s: float = 0.05, resize_steps: int = 1,
                  log=print):
         from flexflow_tpu import obs
 
@@ -58,6 +138,8 @@ class FleetCoordinator:
         self.quantum = max(int(quantum), 1)
         self.seed = int(seed)
         self.log = log
+        self.clock = VirtualClock(step_time_s=step_time_s,
+                                  resize_steps=resize_steps)
         if olog is not None:
             self.olog = olog
         elif obs_dir:
@@ -73,6 +155,11 @@ class FleetCoordinator:
         self.rebalances = 0
         self._packs = 0
         self._demand_key = None
+        self._round = 0
+        self._resizing_steps = 0     # device-steps in motion this round
+        self._drain = None
+        self._t0 = None
+        self._waits_seen: set = set()
 
     # ------------------------------------------------------------------
     # admission
@@ -92,14 +179,19 @@ class FleetCoordinator:
                 meta={"fleet_job": spec.job_id,
                       "workload": spec.kind})
         else:
-            jlog = obs.NULL
+            # no private obs dir: the job shares the pool stream, so a
+            # stream-level driver (fleetsim) still captures every
+            # fleet_job / fleet_wait record
+            jlog = self.olog
         job = Job(spec, olog=jlog, log=self.log)
+        job.attach_clock(self.clock)
         self.jobs.append(job)
         self.olog.event("fleet_job", job=spec.job_id,
                         workload=spec.kind, state="pending",
                         priority=spec.priority,
                         min_devices=spec.min_devices,
-                        max_devices=spec.max_devices)
+                        max_devices=spec.max_devices,
+                        vts=self.clock.now())
         return job
 
     # ------------------------------------------------------------------
@@ -152,28 +244,115 @@ class FleetCoordinator:
         (rebalancing on demand shifts) until every job is done or
         failed.  Returns the fleet summary (also the ``fleet_summary``
         record)."""
-        t0 = time.perf_counter()
+        self.start(drain)
+        while self.step_round(drain):
+            pass
+        return self.finish()
+
+    def start(self, drain: Optional[Dict] = None) -> None:
+        """Initial placement of everything submitted so far, accounted
+        as a round-0 ``fleet_util`` record (placement device-steps are
+        'resizing', the rest of the span is idle)."""
+        self._t0 = time.perf_counter()
         self._drain = drain
+        v0 = self.clock.steps
+        self._resizing_steps = 0
         self._place_initial(drain)
-        round_ = 0
-        while True:
-            running = [j for j in self.jobs if j.state == "running"]
-            if not running:
-                break
-            round_ += 1
-            for job in running:
-                if job.state != "running":
-                    continue
-                try:
-                    job.step_quantum(self.quantum, drain=drain)
-                except Exception as e:  # noqa: BLE001
-                    self.log(f"fleet: job {job.spec.job_id} failed: {e}")
-            if drain is not None and drain.get("requested"):
-                # jobs wind down at their own boundaries; no rebalances
-                # during a drain — keep stepping until everyone exits
+        self._emit_util(v0, busy=0, phase="start")
+
+    def step_round(self, drain: Optional[Dict] = None) -> bool:
+        """ONE quantum round: step every running job, advance the
+        virtual clock by the quantum, rebalance on demand shifts, emit
+        the round's ``fleet_util`` accounting.  Returns False when no
+        job is running (the loop's exit condition)."""
+        if drain is None:
+            drain = self._drain
+        running = [j for j in self.jobs if j.state == "running"]
+        if not running:
+            return False
+        self._round += 1
+        v0 = self.clock.steps
+        self._resizing_steps = 0
+        busy = 0
+        for job in running:
+            if job.state != "running":
                 continue
+            held = len(job.ordinals)
+            try:
+                job.step_quantum(self.quantum, drain=drain)
+            except Exception as e:  # noqa: BLE001
+                self.log(f"fleet: job {job.spec.job_id} failed: {e}")
+            busy += held * min(int(job.last_quantum_steps),
+                               self.quantum)
+        self.clock.advance(self.quantum)
+        if not (drain is not None and drain.get("requested")):
+            # jobs wind down at their own boundaries during a drain; no
+            # rebalances — keep stepping until everyone exits
             self._maybe_rebalance()
-        return self._finish(time.perf_counter() - t0)
+        self._emit_util(v0, busy=busy, phase="round")
+        self._observe_waits()
+        return True
+
+    def place_pending(self) -> int:
+        """Re-pack and place queued jobs WITHOUT stepping anyone —
+        fleetsim's entry point when arrivals land in an empty pool
+        (``step_round`` exits before rebalancing when nothing runs).
+        Placement device-steps are accounted as a 'place'-phase
+        ``fleet_util`` record; if the pack moved nothing (no feasible
+        placement) the clock did not advance and no record is emitted.
+        Returns the number of running jobs afterwards."""
+        v0 = self.clock.steps
+        self._resizing_steps = 0
+        self._maybe_rebalance()
+        if self.clock.steps > v0:
+            self._emit_util(v0, busy=0, phase="place")
+        else:
+            self._resizing_steps = 0
+        return sum(1 for j in self.jobs if j.state == "running")
+
+    def idle_advance(self, steps: int) -> None:
+        """Fast-forward across a gap with nothing runnable (fleetsim's
+        inter-arrival gaps): the whole pool sits idle for the span,
+        recorded as an all-idle ``fleet_util`` round so the accounting
+        still covers every device-second of the day."""
+        steps = int(steps)
+        if steps <= 0:
+            return
+        v0 = self.clock.steps
+        self._resizing_steps = 0
+        self.clock.advance(steps)
+        self._emit_util(v0, busy=0, phase="idle")
+
+    def _emit_util(self, v0: int, busy: int, phase: str) -> None:
+        clk = self.clock
+        span = clk.steps - v0
+        pool = self.pool.num_devices
+        resizing = self._resizing_steps
+        idle = pool * span - busy - resizing
+        st = clk.step_time_s
+        rec = {"round": self._round, "phase": phase, "vts": v0 * st,
+               "pool_devices": pool, "span_steps": span,
+               "busy_steps": busy, "idle_steps": idle,
+               "resizing_steps": resizing, "step_time_s": st,
+               "busy_s": busy * st, "idle_s": idle * st,
+               "resizing_s": resizing * st,
+               "util": (busy / (pool * span)) if span else 0.0}
+        self.olog.event("fleet_util", **rec)
+        self._resizing_steps = 0
+        if self.metrics is not None:
+            self.metrics.update(fleet_util=rec["util"])
+
+    def _observe_waits(self) -> None:
+        """Each newly-terminal job's queue wait lands in the
+        ``ff_fleet_job_wait_s`` histogram exactly once."""
+        if self.metrics is None:
+            return
+        for j in self.jobs:
+            if j.state in ("done", "failed") \
+                    and j.spec.job_id not in self._waits_seen:
+                self._waits_seen.add(j.spec.job_id)
+                self.metrics.observe("fleet_job_wait_s",
+                                     j.vtimes["wait_s"])
 
     def _place_initial(self, drain: Optional[Dict]) -> None:
         self._demand_key = self._demands()
@@ -186,10 +365,13 @@ class FleetCoordinator:
                 self.log(f"fleet: job {job.spec.job_id} does not fit — "
                          f"left pending")
                 continue
+            v_before = self.clock.steps
             job.place(self.pool, ords,
                       strategy=self.arbiter.priced_strategy(
                           job, len(ords)),
                       drain=drain)
+            self._resizing_steps += \
+                (self.clock.steps - v_before) * len(ords)
         self._update_metrics()
 
     def _maybe_rebalance(self) -> None:
@@ -221,7 +403,7 @@ class FleetCoordinator:
                 "fleet_rebalance", rebalance=self.rebalances,
                 moves=[{"job": j.spec.job_id, "from": list(j.ordinals),
                         "to": new} for j, new in moves],
-                sizes=sizes)
+                sizes=sizes, vts=self.clock.now())
             self.log(f"fleet: rebalance #{self.rebalances}: "
                      + ", ".join(f"{j.spec.job_id} "
                                  f"{len(j.ordinals)}->{len(new)}"
@@ -241,6 +423,8 @@ class FleetCoordinator:
                              f"another job")
                     degraded = True
                     continue
+                v_before = self.clock.steps
+                affected = len(set(new) | set(job.ordinals))
                 try:
                     job.resize(self.pool, new)
                 except Exception as e:  # noqa: BLE001
@@ -250,6 +434,8 @@ class FleetCoordinator:
                              f"failed ({e}); job resumes on its "
                              f"{len(job.ordinals)}-device slice")
                     degraded = True
+                self._resizing_steps += \
+                    (self.clock.steps - v_before) * affected
         # queued jobs admitted by the re-pack place after the shrinks
         # that freed their devices
         for job, ords in placements:
@@ -261,10 +447,13 @@ class FleetCoordinator:
                          f"job")
                 degraded = True
                 continue
+            v_before = self.clock.steps
             job.place(self.pool, ords,
                       strategy=self.arbiter.priced_strategy(
                           job, len(ords)),
                       drain=self._drain)
+            self._resizing_steps += \
+                (self.clock.steps - v_before) * len(ords)
         if degraded:
             # the pool is not in the packed shape — force a re-pack at
             # the next round instead of waiting for a demand shift
@@ -272,6 +461,14 @@ class FleetCoordinator:
         if self.metrics is not None:
             self.metrics.update(fleet_rebalances_total=self.rebalances)
         self._update_metrics()
+
+    def finish(self, wall_s: Optional[float] = None) -> Dict:
+        """Close out the run: the ``fleet_summary`` record, final
+        metrics, and every private job stream closed."""
+        if wall_s is None:
+            wall_s = time.perf_counter() - (self._t0 or
+                                            time.perf_counter())
+        return self._finish(wall_s)
 
     def _finish(self, wall_s: float) -> Dict:
         by_state: Dict[str, int] = {}
@@ -282,12 +479,17 @@ class FleetCoordinator:
             entry = {"job": j.spec.job_id, "kind": j.spec.kind,
                      "state": j.state, "devices": len(j.ordinals)}
             if j.spec.kind == "train" and j.result:
-                entry["iters"] = j.result["iters"]
+                entry["iters"] = j.result.get("iters")
                 entry["final_loss"] = (j.result["loss"][-1]
-                                       if j.result["loss"] else None)
+                                       if j.result.get("loss")
+                                       else None)
             if j.spec.kind == "serve" and j.result:
-                entry["completed"] = j.result["completed"]
-                entry["unserved"] = j.result["unserved"]
+                # sim-mode serve jobs report steps, not requests
+                if "completed" in j.result:
+                    entry["completed"] = j.result["completed"]
+                    entry["unserved"] = j.result["unserved"]
+                else:
+                    entry["iters"] = j.result.get("iters")
             if j.error:
                 entry["error"] = j.error
             jobs_out.append(entry)
@@ -298,8 +500,10 @@ class FleetCoordinator:
             "native_prices": self.arbiter.native_prices,
             "proxy_prices": self.arbiter.proxy_prices,
             "wall_s": round(wall_s, 3),
+            "virtual_s": self.clock.now(),
         }
         self.olog.event("fleet_summary", **summary)
+        self._observe_waits()
         self._update_metrics()
         for j in self.jobs:
             if j.olog is not self.olog:

@@ -36,6 +36,24 @@ Two runner shapes:
 Every job logs to its OWN obs stream (``obs_dir/<job_id>/``), so the
 ``elastic_resize`` records a directed resize emits land in the job's
 file while the coordinator's ``fleet_*`` records land in the pool's.
+
+**Lifecycle attribution (round 18).**  The coordinator attaches its
+:class:`~flexflow_tpu.fleet.coordinator.VirtualClock` at admission
+(:meth:`Job.attach_clock`); from then on every ``fleet_job`` transition
+record carries a virtual timestamp ``vts`` and the time spent in the
+state being LEFT is accumulated into one of five buckets — wait
+(pending), placement (placing), run, drain, resize — so that when the
+job reaches ``done``/``failed`` a single ``fleet_wait`` record
+decomposes its whole life, bit-exactly, into those buckets
+(``wait_s + placement_s + run_s + drain_s + resize_s == total_s``).
+
+**Sim mode (apps/fleetsim.py).**  ``JobSpec.sim_steps > 0`` makes the
+job a SYNTHETIC trace job: the full lifecycle / arbiter / rebalance
+machinery runs for real, but ``place`` builds no model and each quantum
+just burns virtual steps — so hundreds of jobs over a virtual day cost
+CPU-milliseconds.  A sim serve job's engine is a :class:`_SimBacklog`
+stub whose queue depth is its remaining steps, so the ``queue_hi``
+demand watermark drives real rebalances.
 """
 
 from __future__ import annotations
@@ -64,6 +82,30 @@ _TRANSITIONS = {
 class JobStateError(RuntimeError):
     """An illegal lifecycle transition (a coordinator bug, not a user
     error — the state machine is the contract)."""
+
+
+# which fleet_wait bucket the time spent in each state accrues to: the
+# bucket is keyed by the state being LEFT at a transition
+_STATE_BUCKET = {
+    "pending": "wait_s",
+    "placing": "placement_s",
+    "running": "run_s",
+    "draining": "drain_s",
+    "resized": "resize_s",
+}
+
+
+class _SimBacklog:
+    """Serve-demand stub for sim jobs: queue depth is the job's
+    remaining virtual steps, so a backlogged sim serve job bids
+    ``max_devices`` until it burns below its ``queue_hi`` watermark —
+    the same demand shift a real engine's queue drives."""
+
+    def __init__(self, job: "Job"):
+        self._job = job
+
+    def queue_depth(self) -> int:
+        return max(int(self._job._sim_left), 0)
 
 
 @dataclasses.dataclass
@@ -97,6 +139,11 @@ class JobSpec:
     #: decode objective (single-token step + KV stream) — so a
     #: disaggregated deployment admits as TWO JobSpecs, one per pool
     serve_phase: str = ""
+    #: virtual-step trace mode (apps/fleetsim.py): >0 makes this a
+    #: SYNTHETIC job that consumes exactly ``sim_steps`` quantum steps
+    #: with no model build — lifecycle, arbiter pricing, and rebalances
+    #: all run for real, only the runner is simulated
+    sim_steps: int = 0
 
     def __post_init__(self):
         if self.kind not in ("train", "serve"):
@@ -115,6 +162,8 @@ class JobSpec:
             raise ValueError(f"job {self.job_id}: max_devices "
                              f"{self.max_devices} < min_devices "
                              f"{self.min_devices}")
+        if self.sim_steps < 0:
+            raise ValueError(f"job {self.job_id}: sim_steps >= 0")
 
 
 class Job:
@@ -141,13 +190,40 @@ class Job:
         self._loss_hist: List[float] = []   # host floats, synced
         self._loss_dev: List = []           # device losses since sync
         self.iters_done = 0
+        # virtual-clock attribution (attach_clock wires the clock; all
+        # vts stamping / fleet_wait emission is gated on it being set)
+        self.clock = None
+        self.submit_v: Optional[float] = None
+        self._last_v: Optional[float] = None
+        self.vtimes: Dict[str, float] = {
+            "wait_s": 0.0, "placement_s": 0.0, "run_s": 0.0,
+            "drain_s": 0.0, "resize_s": 0.0}
+        #: steps actually executed in the most recent step_quantum call
+        #: (the coordinator's per-round busy-device-steps accounting)
+        self.last_quantum_steps = 0
+        # sim mode: remaining virtual steps (0 for real jobs)
+        self._sim_left = int(getattr(spec, "sim_steps", 0) or 0)
+        if self._sim_left > 0 and spec.kind == "serve":
+            self.engine = _SimBacklog(self)
 
     # ------------------------------------------------------------------
     # lifecycle
 
+    def attach_clock(self, clock) -> None:
+        """Wire the coordinator's virtual clock in at admission: from
+        now on every transition is vts-stamped and per-state durations
+        accrue into ``vtimes`` (the ``fleet_wait`` decomposition)."""
+        self.clock = clock
+        self.submit_v = clock.now()
+        self._last_v = self.submit_v
+
     def to_state(self, new: str, **detail) -> None:
         """One legal transition, recorded as a ``fleet_job`` event on the
-        JOB's stream (the coordinator mirrors it on the pool stream)."""
+        JOB's stream (the coordinator mirrors it on the pool stream).
+        With a clock attached the record carries the virtual timestamp
+        ``vts``, the time spent in the state being left accrues to its
+        ``vtimes`` bucket, and a terminal transition additionally emits
+        the job's ``fleet_wait`` decomposition record."""
         if new not in STATES:
             raise JobStateError(f"unknown state {new!r}")
         if new not in _TRANSITIONS[self.state]:
@@ -155,12 +231,31 @@ class Job:
                 f"job {self.spec.job_id}: illegal transition "
                 f"{self.state} -> {new}")
         old, self.state = self.state, new
+        if self.clock is not None:
+            vts = self.clock.now()
+            bucket = _STATE_BUCKET.get(old)
+            if bucket is not None and self._last_v is not None:
+                self.vtimes[bucket] += vts - self._last_v
+            self._last_v = vts
+            detail = dict(detail, vts=vts)
         # "workload", not "kind" — the obs record's own kind field is
         # "fleet_job" and must not be shadowed
         self.olog.event("fleet_job", job=self.spec.job_id,
                         workload=self.spec.kind, state=new,
                         from_state=old, devices=len(self.ordinals),
                         **detail)
+        if self.clock is not None and new in ("done", "failed"):
+            vt = self.vtimes
+            self.olog.event(
+                "fleet_wait", job=self.spec.job_id,
+                workload=self.spec.kind, state=new,
+                devices=len(self.ordinals),
+                wait_s=vt["wait_s"], placement_s=vt["placement_s"],
+                run_s=vt["run_s"], drain_s=vt["drain_s"],
+                resize_s=vt["resize_s"],
+                total_s=(vt["wait_s"] + vt["placement_s"] + vt["run_s"]
+                         + vt["drain_s"] + vt["resize_s"]),
+                submit_v=self.submit_v, done_v=detail["vts"])
 
     @property
     def active(self) -> bool:
@@ -232,6 +327,16 @@ class Job:
 
         self.to_state("placing", ordinals=sorted(int(i) for i in ordinals))
         self.ordinals = sorted(int(i) for i in ordinals)
+        if self.clock is not None:
+            # placement costs virtual time: the placing -> running gap
+            # is what fleet_wait's placement_s bucket measures
+            self.clock.advance(self.clock.resize_steps)
+        if self.spec.sim_steps > 0:
+            # sim mode: no model, no slice — the lifecycle walk and the
+            # arbiter's DP-proxy pricing are the whole point
+            self.strategy = strategy
+            self.to_state("running")
+            return
         machine = pool.slice_of(self.ordinals)
         cfg = copy.copy(self.spec.config)
         # the elastic shrink path enforces cfg.min_devices — align it
@@ -273,9 +378,12 @@ class Job:
         """Up to ``n`` steps (train iterations / decode boundaries).
         Returns True while the job has work left; on exhaustion the job
         transitions to ``done`` with its result attached."""
+        self.last_quantum_steps = 0
         if self.state != "running":
             return self.active
         try:
+            if self.spec.sim_steps > 0:
+                return self._sim_quantum(n, drain)
             if self.spec.kind == "train":
                 return self._train_quantum(n, drain)
             return self._serve_quantum(n)
@@ -299,6 +407,7 @@ class Job:
                 self._params, self._state, self._opt, *placed)
             self._loss_dev.append(loss)
             self.iters_done += 1
+            self.last_quantum_steps += 1
         drained = bool(drain is not None and drain.get("requested"))
         if self.iters_done >= total or drained:
             self._sync_losses()
@@ -313,11 +422,32 @@ class Job:
             return False
         return True
 
+    def _sim_quantum(self, n: int, drain: Optional[Dict]) -> bool:
+        """Burn up to ``n`` virtual steps of the synthetic trace."""
+        for _ in range(n):
+            if self._sim_left <= 0:
+                break
+            if drain is not None and drain.get("requested"):
+                break
+            self._sim_left -= 1
+            self.iters_done += 1
+            self.last_quantum_steps += 1
+        drained = bool(drain is not None and drain.get("requested"))
+        if self._sim_left <= 0 or drained:
+            self.result = {"iters": self.iters_done, "sim": True,
+                           "devices": len(self.ordinals),
+                           "drained": drained and self._sim_left > 0}
+            self.to_state("done", iters=self.iters_done,
+                          drained=self.result["drained"])
+            return False
+        return True
+
     def _serve_quantum(self, n: int) -> bool:
         eng = self.engine
         for _ in range(n):
             if not eng.step_once():
                 break
+            self.last_quantum_steps += 1
         if not eng.pending():
             self.result = eng.finish()
             self.to_state("done",
@@ -362,21 +492,38 @@ class Job:
                 f"keep every job anchored (nested or overlapping moves "
                 f"only)")
         self.to_state("draining", target=new)
+        if self.clock is not None:
+            # the drain-to-boundary span (draining -> resized gap)
+            self.clock.advance(self.clock.resize_steps)
         legs = []
         inter = sorted(set(new) & set(old))
-        try:
-            if inter != old:      # release what the target drops
-                legs.append(self._resize_leg(pool, inter, old))
-                self.ordinals = inter
-            if new != inter:      # adopt what the target adds
-                legs.append(self._resize_leg(pool, new, inter))
+        if self.spec.sim_steps > 0:
+            # sim mode: the lifecycle walk + clock cost of a move, with
+            # no live state to regrid
+            if inter != old:
+                legs.append({"direction": "shrink",
+                             "devices": len(inter)})
+            if new != inter:
+                legs.append({"direction": "grow", "devices": len(new)})
             self.ordinals = new
-        except Exception as e:  # noqa: BLE001 — abort, resume in place
-            self.to_state("running", resize_failed=f"{type(e).__name__}",
-                          ordinals=list(self.ordinals))
-            raise
+        else:
+            try:
+                if inter != old:      # release what the target drops
+                    legs.append(self._resize_leg(pool, inter, old))
+                    self.ordinals = inter
+                if new != inter:      # adopt what the target adds
+                    legs.append(self._resize_leg(pool, new, inter))
+                self.ordinals = new
+            except Exception as e:  # noqa: BLE001 — abort, resume in place
+                self.to_state("running",
+                              resize_failed=f"{type(e).__name__}",
+                              ordinals=list(self.ordinals))
+                raise
         self.to_state("resized", ordinals=new,
                       directions=[r["direction"] for r in legs])
+        if self.clock is not None:
+            # the regrid span (resized -> running gap)
+            self.clock.advance(self.clock.resize_steps)
         self.to_state("running")
         return legs
 

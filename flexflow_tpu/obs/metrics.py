@@ -111,6 +111,8 @@ _HELP = {
                          "{job=...}; the plain series is the pool's "
                          "assigned total",
     "fleet_rebalances_total": "fleet packing rebalances this run",
+    "fleet_util": "pool utilization last fleet round (busy device-steps "
+                  "/ pool capacity x round span, 0..1)",
 }
 _COUNTER_EXTRA = {"fleet_rebalances_total"}
 _COUNTERS = {"steps_total", "rollbacks_total", "faults_total",
@@ -128,6 +130,8 @@ _HIST_HELP = {
     "request_latency_s": "serving request latency (virtual seconds, "
                          "arrival to completion)",
     "request_ttft_s": "serving time-to-first-token (virtual seconds)",
+    "fleet_job_wait_s": "fleet job queue wait (virtual seconds, submit "
+                        "to placement start)",
 }
 
 

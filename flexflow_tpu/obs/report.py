@@ -643,17 +643,24 @@ def _trace_section(events: List[Dict]) -> List[str]:
 
 
 def _fleet_section(events: List[Dict]) -> List[str]:
-    """The coordinator's view: per-job lifecycle trails, each arbiter
-    packing, each executed rebalance, and the final fleet summary.
-    Renders merged multi-job streams (coordinator + per-job subdirs)
-    as readily as the coordinator's stream alone."""
+    """The coordinator's view: per-job lifecycle trails, wait
+    decompositions (``fleet_wait``), each arbiter packing, each
+    executed rebalance, the device-second utilization account
+    (``fleet_util``), fleet-simulation sweep points (``fleetsim``),
+    and the final fleet summary.  Renders merged multi-job streams
+    (coordinator + per-job subdirs) as readily as the coordinator's
+    stream alone."""
     jobs = [e for e in events if e.get("kind") == "fleet_job"]
     placements = [e for e in events
                   if e.get("kind") == "fleet_placement"]
     rebalances = [e for e in events
                   if e.get("kind") == "fleet_rebalance"]
     summaries = [e for e in events if e.get("kind") == "fleet_summary"]
-    if not (jobs or placements or rebalances or summaries):
+    waits = [e for e in events if e.get("kind") == "fleet_wait"]
+    utils = [e for e in events if e.get("kind") == "fleet_util"]
+    sims = [e for e in events if e.get("kind") == "fleetsim"]
+    if not (jobs or placements or rebalances or summaries or waits
+            or utils or sims):
         return []
     lines = ["== fleet =="]
     trail: Dict[str, List[str]] = {}
@@ -678,6 +685,36 @@ def _fleet_section(events: List[Dict]) -> List[str]:
             f"{m.get('job')} {len(m.get('from') or [])}->"
             f"{len(m.get('to') or [])}" for m in r.get("moves") or [])
         lines.append(f"  rebalance #{r.get('rebalance', '?')}: {moves}")
+    for w in waits:
+        lines.append(
+            f"  wait {w.get('job', '?')}: "
+            f"wait {_fmt_s(w.get('wait_s') or 0.0)} + place "
+            f"{_fmt_s(w.get('placement_s') or 0.0)} + run "
+            f"{_fmt_s(w.get('run_s') or 0.0)} + drain "
+            f"{_fmt_s(w.get('drain_s') or 0.0)} + resize "
+            f"{_fmt_s(w.get('resize_s') or 0.0)} = "
+            f"{_fmt_s(w.get('total_s') or 0.0)} ({w.get('state', '?')})")
+    if utils:
+        busy = sum(int(u.get("busy_steps") or 0) for u in utils)
+        idle = sum(int(u.get("idle_steps") or 0) for u in utils)
+        rsz = sum(int(u.get("resizing_steps") or 0) for u in utils)
+        cap = busy + idle + rsz
+        lines.append(
+            f"  util: {len(utils)} round(s), {busy} busy + {idle} idle "
+            f"+ {rsz} resizing device-step(s)"
+            + (f" -> {100.0 * busy / cap:.1f}% busy" if cap else ""))
+    for p in sims:
+        slo = p.get("slo_compliant")
+        lines.append(
+            f"  fleetsim[pool {p.get('pool', '?')}]: "
+            f"{p.get('jobs_done', '?')}/{p.get('jobs', '?')} job(s) "
+            f"done, util {100.0 * (p.get('util') or 0.0):.1f}%, wait "
+            f"p50 {_fmt_s(p.get('wait_p50_s') or 0.0)} p99 "
+            f"{_fmt_s(p.get('wait_p99_s') or 0.0)}, "
+            f"{p.get('rebalances', 0)} rebalance(s), churn "
+            f"{p.get('churn_devices', 0)} device(s), wait-slo "
+            + ("?" if slo is None
+               else ("COMPLIANT" if slo else "VIOLATED")))
     if summaries:
         s = summaries[-1]
         lines.append(
@@ -708,7 +745,7 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "serve_summary", "serve_handoff", "kv_refetch",
              "router_summary",
              "fleet_job", "fleet_placement", "fleet_rebalance",
-             "fleet_summary"}
+             "fleet_summary", "fleet_wait", "fleet_util", "fleetsim"}
     lines = []
     for e in events:
         kind = e.get("kind")
@@ -1042,8 +1079,13 @@ def summarize(events: Iterable[Dict]) -> Dict:
         out["loadtest"] = [{k: v for k, v in p.items()
                             if k not in ("run", "ts", "kind", "surface")}
                            for p in points]
+    points = [e for e in events if e.get("kind") == "fleetsim"]
+    if points:
+        out["fleetsim"] = [{k: v for k, v in p.items()
+                            if k not in ("run", "ts", "kind", "surface")}
+                           for p in points]
     fleet_kinds = ("fleet_job", "fleet_placement", "fleet_rebalance",
-                   "fleet_summary")
+                   "fleet_summary", "fleet_wait", "fleet_util")
     if any(kinds.get(k) for k in fleet_kinds):
         fl: Dict = {"counts": {k: kinds[k] for k in fleet_kinds
                                if kinds.get(k)},
@@ -1071,13 +1113,29 @@ def summarize(events: Iterable[Dict]) -> Dict:
                   "from_devices": len(m.get("from") or []),
                   "to_devices": len(m.get("to") or [])}
                  for m in r.get("moves") or []] for r in moves]
+        waits = [e for e in events if e.get("kind") == "fleet_wait"]
+        if waits:
+            fl["waits"] = [{k: w.get(k) for k in
+                            ("job", "workload", "state", "wait_s",
+                             "placement_s", "run_s", "drain_s",
+                             "resize_s", "total_s", "submit_v",
+                             "done_v")} for w in waits]
+        utils = [e for e in events if e.get("kind") == "fleet_util"]
+        if utils:
+            busy = sum(int(u.get("busy_steps") or 0) for u in utils)
+            idle = sum(int(u.get("idle_steps") or 0) for u in utils)
+            rsz = sum(int(u.get("resizing_steps") or 0) for u in utils)
+            cap = busy + idle + rsz
+            fl["util"] = {"rounds": len(utils), "busy_steps": busy,
+                          "idle_steps": idle, "resizing_steps": rsz,
+                          "busy_frac": (busy / cap) if cap else 0.0}
         fsums = [e for e in events if e.get("kind") == "fleet_summary"]
         if fsums:
             s = fsums[-1]
             fl["summary"] = {k: s.get(k) for k in
                              ("pool_devices", "by_state", "rebalances",
                               "packs", "native_prices", "proxy_prices",
-                              "wall_s")}
+                              "wall_s", "virtual_s")}
         out["fleet"] = fl
     fault_kinds = ("fault", "rollback", "recovery", "data_fault",
                    "ckpt_fallback", "thread_leak")

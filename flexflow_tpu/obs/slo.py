@@ -92,15 +92,19 @@ def _percentile(values: List[float], q: float) -> Optional[float]:
     return float(vs[lo] + (vs[hi] - vs[lo]) * frac)
 
 
-def _completed_requests(events: Iterable[Dict]) -> List[Dict]:
+def _completed_requests(events: Iterable[Dict], *,
+                        kind: str = "serve_request",
+                        latency_field: str = "latency_s",
+                        time_field: str = "done_v") -> List[Dict]:
     return [e for e in events
-            if e.get("kind") == "serve_request"
-            and e.get("done_v") is not None
-            and e.get("latency_s") is not None]
+            if e.get("kind") == kind
+            and e.get(time_field) is not None
+            and e.get(latency_field) is not None]
 
 
-def _violates(rec: Dict, spec: SLOSpec) -> bool:
-    return float(rec["latency_s"]) > spec.latency_target_s
+def _violates(rec: Dict, spec: SLOSpec, *,
+              latency_field: str = "latency_s") -> bool:
+    return float(rec[latency_field]) > spec.latency_target_s
 
 
 def _burn(bad: int, total: int, budget: float) -> float:
@@ -110,17 +114,25 @@ def _burn(bad: int, total: int, budget: float) -> float:
     return error_rate / budget
 
 
-def burn_rate_windows(events: Iterable[Dict],
-                      spec: SLOSpec) -> List[Dict]:
-    """Tile the stream's ``done_v`` span with ``spec.window_s``-wide
-    windows and compute the burn rate in each.  Empty stream -> ``[]``;
-    a degenerate span (every request completing at the same instant)
-    is one window.  Windows with zero completions report burn 0.0 —
-    no traffic burns no budget."""
-    reqs = _completed_requests(events)
+def burn_rate_windows(events: Iterable[Dict], spec: SLOSpec, *,
+                      kind: str = "serve_request",
+                      latency_field: str = "latency_s",
+                      time_field: str = "done_v") -> List[Dict]:
+    """Tile the stream's completion-time (``time_field``) span with
+    ``spec.window_s``-wide windows and compute the burn rate in each.
+    Empty stream -> ``[]``; a degenerate span (every request completing
+    at the same instant) is one window.  Windows with zero completions
+    report burn 0.0 — no traffic burns no budget.
+
+    The defaults are the serving shape (``serve_request`` /
+    ``latency_s`` / ``done_v``); a wait-time SLO over a fleet stream is
+    the SAME math with ``kind="fleet_wait", latency_field="wait_s"``."""
+    reqs = _completed_requests(events, kind=kind,
+                               latency_field=latency_field,
+                               time_field=time_field)
     if not reqs:
         return []
-    times = [float(r["done_v"]) for r in reqs]
+    times = [float(r[time_field]) for r in reqs]
     t0, t_end = min(times), max(times)
     n_win = max(1, int(math.ceil((t_end - t0) / spec.window_s)) or 1)
     if t0 + n_win * spec.window_s <= t_end:  # endpoint lands on edge
@@ -129,9 +141,10 @@ def burn_rate_windows(events: Iterable[Dict],
     for k in range(n_win):
         w0 = t0 + k * spec.window_s
         w1 = w0 + spec.window_s
-        members = [r for r in reqs if w0 <= float(r["done_v"]) < w1
-                   or (k == n_win - 1 and float(r["done_v"]) == w1)]
-        bad = sum(1 for r in members if _violates(r, spec))
+        members = [r for r in reqs if w0 <= float(r[time_field]) < w1
+                   or (k == n_win - 1 and float(r[time_field]) == w1)]
+        bad = sum(1 for r in members
+                  if _violates(r, spec, latency_field=latency_field))
         total = len(members)
         windows.append({
             "t0": w0, "t1": w1, "total": total, "bad": bad,
@@ -141,7 +154,10 @@ def burn_rate_windows(events: Iterable[Dict],
     return windows
 
 
-def evaluate(events: Iterable[Dict], spec: SLOSpec) -> Dict:
+def evaluate(events: Iterable[Dict], spec: SLOSpec, *,
+             kind: str = "serve_request",
+             latency_field: str = "latency_s",
+             time_field: str = "done_v") -> Dict:
     """Whole-stream SLO verdict for one spec.
 
     Returns totals, whole-stream and worst-window burn rates, the
@@ -149,16 +165,26 @@ def evaluate(events: Iterable[Dict], spec: SLOSpec) -> Dict:
     (achieved percentile within target — the SLO statement itself),
     and ``goodput_qps`` (SLO-meeting completions per virtual second of
     the stream's completion span).  An empty stream is vacuously
-    compliant with zero burn."""
+    compliant with zero burn.  ``kind`` / ``latency_field`` /
+    ``time_field`` retarget the same math at any record family that
+    stamps a completion time and a latency-like value — e.g. a
+    wait-time SLO over ``fleet_wait`` records (``latency_field=
+    "wait_s"``), which is how apps/fleetsim.py scores each pool
+    size."""
     events = list(events)
-    reqs = _completed_requests(events)
-    windows = burn_rate_windows(reqs, spec)
+    reqs = _completed_requests(events, kind=kind,
+                               latency_field=latency_field,
+                               time_field=time_field)
+    windows = burn_rate_windows(reqs, spec, kind=kind,
+                                latency_field=latency_field,
+                                time_field=time_field)
     total = len(reqs)
-    bad = sum(1 for r in reqs if _violates(r, spec))
+    bad = sum(1 for r in reqs
+              if _violates(r, spec, latency_field=latency_field))
     good = total - bad
-    latencies = [float(r["latency_s"]) for r in reqs]
+    latencies = [float(r[latency_field]) for r in reqs]
     achieved = _percentile(latencies, spec.percentile)
-    span = (max(float(r["done_v"]) for r in reqs)) if reqs else 0.0
+    span = (max(float(r[time_field]) for r in reqs)) if reqs else 0.0
     return {
         "spec": spec.to_dict(),
         "total": total,

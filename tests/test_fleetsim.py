@@ -1,0 +1,334 @@
+"""Fleet observatory (round 18): the trace-driven fleet simulation
+(apps/fleetsim.py), the virtual-clock lifecycle attribution behind it
+(fleet_wait decompositions, the fleet_util device-second invariant),
+the lifecycle Perfetto lanes + rebalance flow arrows, the ``report
+fleet`` subcommand, and the committed FLEET_r01.json artifact."""
+
+import json
+import math
+import os
+
+import pytest
+
+from flexflow_tpu.apps import fleetsim
+
+
+def small_opts(tmp_path, **over):
+    """A seconds-fast sweep config: one virtual half-hour, a handful of
+    jobs, jax-free throughout."""
+    opts = fleetsim.parse_args([])
+    opts.update({"jobs": 10, "day_s": 1800.0, "pools": "4",
+                 "quantum": 4, "step_time_s": 10.0, "resize_steps": 2,
+                 "slo_wait_s": 300.0,
+                 "obs_dir": str(tmp_path)})
+    opts.update(over)
+    return opts
+
+
+def run_point(tmp_path, tag="a", **over):
+    opts = small_opts(tmp_path, **over)
+    path = os.path.join(str(tmp_path), f"stream_{tag}.jsonl")
+    point = fleetsim._sweep_point(4, opts, path, lambda *a: None)
+    from flexflow_tpu import obs
+
+    return point, list(obs.read_run(path)), path
+
+
+# ---------------------------------------------------------------------------
+# flags + job generation
+
+
+def test_parse_defaults_and_smoke_caps():
+    opts = fleetsim.parse_args([])
+    assert opts["pools"] == "8,16,32" and opts["jobs"] == 120
+    assert opts["day_s"] == 86400.0 and opts["seed"] == 0
+    assert opts["pattern"] == "diurnal+bursty"
+    smoke = fleetsim.parse_args(["--smoke", "--jobs", "500",
+                                 "--day-s", "999999"])
+    assert smoke["jobs"] <= 24 and smoke["day_s"] <= 7200.0
+    assert smoke["pools"] == "4,8"
+    with pytest.raises(SystemExit):
+        fleetsim.parse_args(["--jobs", "0"])
+    with pytest.raises(SystemExit):
+        fleetsim.parse_args(["--step-time-s", "0"])
+
+
+def test_gen_jobs_deterministic_and_shaped():
+    opts = fleetsim.parse_args(["--jobs", "40"])
+    a = fleetsim.gen_jobs(opts)
+    b = fleetsim.gen_jobs(opts)
+    assert a == b  # bit-reproducible under the seed
+    c = fleetsim.gen_jobs(dict(opts, seed=7))
+    assert a != c
+    arrivals = [t for t, _ in a]
+    assert arrivals == sorted(arrivals)
+    assert 0.0 < arrivals[-1]
+    for _, kw in a:
+        assert kw["kind"] in ("train", "serve")
+        assert 1 <= kw["min_devices"] <= kw["max_devices"]
+        assert 8 <= kw["sim_steps"] <= 2000
+        if kw["kind"] == "serve":
+            assert kw["queue_hi"] >= 4
+        else:
+            assert kw["queue_hi"] == 0
+    kinds = {kw["kind"] for _, kw in a}
+    assert kinds == {"train", "serve"}
+
+
+# ---------------------------------------------------------------------------
+# determinism + the fleet_util invariant
+
+
+def test_sweep_point_bit_deterministic(tmp_path):
+    p1, _, _ = run_point(tmp_path, tag="a")
+    p2, _, _ = run_point(tmp_path, tag="b")
+    assert json.dumps(p1, sort_keys=True) == \
+        json.dumps(p2, sort_keys=True)
+    p3, _, _ = run_point(tmp_path, tag="c", seed=5)
+    assert json.dumps(p1, sort_keys=True) != \
+        json.dumps(p3, sort_keys=True)
+
+
+def test_point_payload_sane(tmp_path):
+    point, events, _ = run_point(tmp_path)
+    assert point["jobs"] == 10
+    assert point["jobs_done"] + point["jobs_failed"] <= point["jobs"]
+    assert point["jobs_done"] > 0
+    assert point["util_violations"] == 0
+    assert 0.0 < point["util"] <= 1.0
+    for k in ("wait_p50_s", "wait_p90_s", "wait_p99_s"):
+        assert math.isfinite(point[k]) and point[k] >= 0.0
+    assert point["wait_p50_s"] <= point["wait_p90_s"] \
+        <= point["wait_p99_s"]
+    assert point["virtual_s"] > 0.0
+    # the day's accounting covers every device-second exactly once
+    total = point["busy_steps"] + point["idle_steps"] \
+        + point["resizing_steps"]
+    span = sum(e["span_steps"] for e in events
+               if e.get("kind") == "fleet_util")
+    assert total == 4 * span
+    # one fleetsim record carries the payload
+    sims = [e for e in events if e.get("kind") == "fleetsim"]
+    assert len(sims) == 1 and sims[0]["pool"] == 4
+
+
+def test_fleet_util_invariant_positive_and_negative(tmp_path):
+    from flexflow_tpu.fleet import check_fleet_util
+
+    _, events, _ = run_point(tmp_path)
+    utils = [e for e in events if e.get("kind") == "fleet_util"]
+    assert utils
+    for u in utils:
+        assert check_fleet_util(u) == []
+    # tampering with any bucket breaks the exact accounting
+    bad = dict(utils[0], busy_steps=utils[0]["busy_steps"] + 1)
+    probs = check_fleet_util(bad)
+    assert probs and "device-steps" in probs[0]
+    assert check_fleet_util(dict(utils[0], idle_steps=-1))
+    assert check_fleet_util(dict(utils[0], span_steps=1.5))
+    assert check_fleet_util(dict(utils[0], busy_steps=True))
+    # and so does a seconds field out of step with its bucket
+    bad_s = dict(utils[0], busy_s=(utils[0]["busy_s"] or 0.0) + 1.0)
+    assert any("busy_s" in p for p in check_fleet_util(bad_s))
+
+
+# ---------------------------------------------------------------------------
+# wait attribution on a forced rebalance
+
+
+@pytest.fixture()
+def forced_rebalance(tmp_path):
+    """Two sim jobs hand-driven through the real coordinator: a train
+    job holding the whole 4-device pool, then a serve arrival whose
+    backlogged bid forces a rebalance — so the late job WAITS and the
+    early job pays drain+resize time."""
+    from flexflow_tpu import obs
+    from flexflow_tpu.fleet import FleetCoordinator
+    from flexflow_tpu.fleet.arbiter import Arbiter
+    from flexflow_tpu.fleet.job import JobSpec
+    from flexflow_tpu.machine import MachineModel
+
+    path = str(tmp_path / "forced.jsonl")
+    olog = obs.RunLog(path, surface="fleet")
+    coord = FleetCoordinator(
+        MachineModel.virtual(4), olog=olog,
+        pricer=Arbiter.proxy_pricer, quantum=4, step_time_s=10.0,
+        resize_steps=2, log=lambda *a: None)
+    arrivals = [
+        (0.0, JobSpec(job_id="early", kind="train", build=None,
+                      config=None, min_devices=1, max_devices=4,
+                      sim_steps=60)),
+        (95.0, JobSpec(job_id="late", kind="serve", build=None,
+                       config=None, min_devices=2, max_devices=2,
+                       queue_hi=4, sim_steps=40)),
+    ]
+    fleetsim._drive(coord, arrivals, 10.0, lambda *a: None)
+    olog.close()
+    return coord, list(obs.read_run(path))
+
+
+def test_wait_attribution_forced_rebalance(forced_rebalance):
+    coord, events = forced_rebalance
+    waits = {e["job"]: e for e in events
+             if e.get("kind") == "fleet_wait"}
+    assert set(waits) == {"early", "late"}
+    for w in waits.values():
+        parts = [w[k] for k in ("wait_s", "placement_s", "run_s",
+                                "drain_s", "resize_s")]
+        assert all(math.isfinite(p) and p >= 0.0 for p in parts)
+        assert abs(sum(parts) - w["total_s"]) < 1e-9
+        assert abs((w["done_v"] - w["submit_v"]) - w["total_s"]) < 1e-9
+        assert w["run_s"] > 0.0
+    assert coord.rebalances >= 1
+    # the late arrival queued behind the incumbent's full-pool slice
+    assert waits["late"]["wait_s"] > 0.0
+    # the incumbent was directed-resized: it paid drain + resize time
+    assert waits["early"]["drain_s"] > 0.0
+    assert waits["early"]["resize_s"] > 0.0
+    # and the per-job vtimes mirror the records bit-exactly
+    early = next(j for j in coord.jobs if j.spec.job_id == "early")
+    assert early.vtimes["drain_s"] == waits["early"]["drain_s"]
+
+
+def test_lifecycle_trace_lanes_and_flow(forced_rebalance):
+    from flexflow_tpu.obs import trace as obstrace
+
+    _, events = forced_rebalance
+    tr = obstrace.chrome_trace(obstrace.fleet_trace_events(events))
+    assert obstrace.validate_trace(tr) == []
+    evs = tr["traceEvents"]
+    spans = [e for e in evs if e.get("cat") == "lifecycle"]
+    by_job = {}
+    for e in spans:
+        by_job.setdefault(e["args"]["job"], []).append(e["name"])
+    assert set(by_job) == {"early", "late"}
+    for names in by_job.values():
+        assert names[0] == "pending"
+        assert names[-1] == "done"
+        assert "running" in names
+    # the resized incumbent's lane shows the directed resize
+    assert "draining" in by_job["early"]
+    # rebalance markers pair with the resizes they caused via flow
+    # arrows: every flow id has exactly one start and one finish
+    starts = {e["id"] for e in evs if e.get("ph") == "s"}
+    finishes = {e["id"] for e in evs if e.get("ph") == "f"}
+    assert starts and starts == finishes
+    sched = [e for e in evs if e.get("cat") == "sched"
+             and e.get("ph") == "X"]
+    assert any(e["name"].startswith("rebalance") for e in sched)
+    # the pool-utilization counter lane is present and finite
+    util = [e for e in evs if e.get("ph") == "C"
+            and e.get("name") == "pool util"]
+    assert util
+    assert all(math.isfinite(v) for e in util
+               for v in e["args"].values())
+
+
+# ---------------------------------------------------------------------------
+# report fleet
+
+
+def test_report_fleet_text_json_and_rc1(tmp_path, capsys):
+    from flexflow_tpu.apps import report
+
+    _, events, path = run_point(tmp_path)
+    rc = report.main(["fleet", path])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "== fleet ==" in text
+    assert "fleetsim[pool 4]" in text
+    assert "util:" in text and "wait sim-" in text
+    rc = report.main(["fleet", path, "--json"])
+    js = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert js["fleet"]["util"]["busy_steps"] > 0
+    assert js["fleet"]["waits"]
+    assert js["fleetsim"][0]["pool"] == 4
+    # a stream with no fleet records exits 1 with the hint
+    p = tmp_path / "empty.jsonl"
+    p.write_text(json.dumps({"kind": "run_start", "run": "x"}) + "\n")
+    rc = report.main(["fleet", str(p)])
+    assert rc == 1
+    assert "no fleet_* records" in capsys.readouterr().out
+
+
+def test_report_fleet_flags_invariant_violation(tmp_path, capsys):
+    from flexflow_tpu.apps import report
+
+    _, events, _ = run_point(tmp_path)
+    u = next(e for e in events if e.get("kind") == "fleet_util")
+    bad = dict(u, busy_steps=u["busy_steps"] + 3)
+    p = tmp_path / "tampered.jsonl"
+    p.write_text(json.dumps(bad) + "\n")
+    rc = report.main(["fleet", str(p)])
+    text = capsys.readouterr().out
+    assert rc == 1
+    assert "FLEET_UTIL INVARIANT VIOLATED" in text
+    rc = report.main(["fleet", str(p), "--json"])
+    js = json.loads(capsys.readouterr().out)
+    assert rc == 1 and js["util_violations"]
+
+
+def test_report_slo_retargets_fleet_wait(tmp_path, capsys):
+    """The generalized SLO pass reads wait times off a fleet stream."""
+    from flexflow_tpu.apps import report
+
+    _, _, path = run_point(tmp_path)
+    rc = report.main(["slo", path, "--kind", "fleet_wait",
+                      "--latency-field", "wait_s",
+                      "--target-s", "1e9", "--json"])
+    js = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert js["total"] > 0 and js["compliant"] is True
+
+
+def test_summarize_and_render_carry_fleetsim(tmp_path):
+    from flexflow_tpu.obs.report import render, summarize
+
+    _, events, _ = run_point(tmp_path)
+    s = summarize(events)
+    assert s["fleetsim"][0]["pool"] == 4
+    assert s["fleet"]["util"]["busy_steps"] > 0
+    by_state = s["fleet"]["summary"]["by_state"]
+    assert len(s["fleet"]["waits"]) == \
+        by_state.get("done", 0) + by_state.get("failed", 0)
+    text = render(events)
+    assert "fleetsim[pool 4]" in text
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact
+
+
+ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "FLEET_r01.json")
+
+
+@pytest.mark.skipif(not os.path.exists(ARTIFACT),
+                    reason="FLEET_r01.json not committed")
+def test_fleet_r01_artifact_schema_and_monotone_util():
+    with open(ARTIFACT) as f:
+        art = json.load(f)
+    assert art["schema"] == "fleet_bench_v1"
+    assert art["seed"] == 0
+    assert art["jobs"] >= 100
+    assert art["day_s"] >= 86400.0
+    points = art["points"]
+    assert len(points) >= 3
+    pools = [p["pool"] for p in points]
+    assert pools == sorted(pools)
+    for p in points:
+        assert p["util_violations"] == 0
+        assert 0.0 < p["util"] <= 1.0
+        for k in ("wait_p50_s", "wait_p90_s", "wait_p99_s"):
+            assert math.isfinite(p[k]) and p[k] >= 0.0
+        assert p["jobs_done"] + p["jobs_failed"] <= p["jobs"]
+        assert p["jobs"] == art["jobs"]
+    # more pool under the same offered load -> lower utilization
+    utils = [p["util"] for p in points]
+    assert utils == sorted(utils, reverse=True)
+    # and the big pool waits less at the tail than the small one
+    assert points[-1]["wait_p99_s"] <= points[0]["wait_p99_s"]
+    assert art["parsed"]["metric"] == \
+        f"fleet_sim_util_{pools[0]}dev"
+    assert art["parsed"]["value"] == round(points[0]["util"], 4)

@@ -416,8 +416,15 @@ def test_fleet_trace_events_per_job_occupancy():
     b = [e for e in counters if e["name"] == "job serve-b devices"]
     assert len(b) == 1 and b[0]["args"]["devices"] == 2.0
     assert not [e for e in counters if "pending-c" in e["name"]]
-    # wall-clock axis normalized to the stream start
-    assert min(e["ts"] for e in counters) == 0.0
+    # wall-clock axis normalized to the stream start (pending-c's
+    # 99.5 lifecycle sample is the earliest timed event)
+    timed = [e for e in events if e.get("ph") != "M"]
+    assert min(e["ts"] for e in timed) == 0.0
+    assert all(e["ts"] >= 0.0 for e in timed)
+    # pending-c still gets a LIFECYCLE lane even without devices
+    assert [e["name"] for e in events
+            if e.get("cat") == "lifecycle"
+            and e["args"].get("job") == "pending-c"] == ["pending"]
     # no samples -> just the meta event
     assert len(obstrace.fleet_trace_events(
         [{"kind": "fleet_job", "job": "x", "state": "running"}])) == 1
