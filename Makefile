@@ -3,7 +3,8 @@ PYTHON ?= python
 
 .PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
 	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke \
-	serve-smoke fleet-smoke loadtest-smoke disagg-smoke fleetsim-smoke
+	serve-smoke fleet-smoke loadtest-smoke disagg-smoke fleetsim-smoke \
+	searchscale-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -17,7 +18,7 @@ native:
 # every emitted obs record kind must be rendered by obs/report.py and
 # covered by a test (tools/check_obs_kinds.py), and the static strategy
 # verifier must come up clean (lint)
-check: lint fusion-smoke serve-smoke disagg-smoke fleet-smoke loadtest-smoke fleetsim-smoke
+check: lint fusion-smoke serve-smoke disagg-smoke fleet-smoke loadtest-smoke fleetsim-smoke searchscale-smoke
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
@@ -281,6 +282,60 @@ fleetsim-smoke:
 	print('fleetsim-smoke ok:', {k: rec[k] for k in \
 	('metric','value','vs_baseline','sweep_points','wait_p99_s', \
 	'rebalances','repro','trace_validated')})"
+
+# decomposed-search smoke (round 19): tiny 4-layer graph on the 8-device
+# virtual mesh, searched flat AND decomposed at the same proposal budget
+# — proves the stitch passes the plan gate, the shared-block memo hits,
+# and the deterministic payload is bit-identical across two runs; the
+# second block re-validates the committed SEARCH_r01.json (schema,
+# finiteness, and the acceptance pins: decomposed >= 1.15x vs DP AND
+# strictly better than flat on the 1.3b headline row, memo hits on
+# every multi-layer row)
+searchscale-smoke:
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.searchscale --smoke \
+	| $(PYTHON) -c "import json,math,sys; \
+	rec=json.loads(sys.stdin.readline()); \
+	assert sys.stdin.readline() == '', 'stdout must be one JSON line'; \
+	assert all(k in rec for k in \
+	('metric','value','unit','vs_baseline')), rec; \
+	assert rec['unit'] == 'x_vs_dp', rec; \
+	assert math.isfinite(rec['value']) and rec['value'] >= 1.0, rec; \
+	assert rec['repro'] is True, rec; \
+	assert rec['memo_hits'] >= 1, rec; \
+	assert rec['plan_gate_clean'] is True, rec; \
+	assert rec['unique_blocks'] < rec['blocks'], rec; \
+	print('searchscale-smoke ok:', {k: rec[k] for k in \
+	('metric','value','vs_baseline','blocks','unique_blocks', \
+	'memo_hits','repro')})"
+	$(PYTHON) -c "import json,math; \
+	art=json.load(open('SEARCH_r01.json')); \
+	assert art['schema'] == 'searchscale_bench_v1', art; \
+	assert art['seed'] == 0, art; \
+	assert art['parsed']['unit'] == 'x_vs_dp', art; \
+	rows={r['size']: r for r in art['rows']}; \
+	head=rows[art['headline']]; \
+	assert head['params'] > 1_000_000_000, head['params']; \
+	assert head['decomposed']['speedup_vs_dp'] >= 1.15, head; \
+	assert head['decomposed']['best_time_s'] \
+	< head['flat']['best_time_s'], head; \
+	assert art['parsed']['value'] \
+	== head['decomposed']['speedup_vs_dp'], art['parsed']; \
+	assert all(r['decomposed']['memo_hits'] >= 1 for r in art['rows'] \
+	if r['layers'] >= 3), rows.keys(); \
+	assert all(r['decomposed']['plan_gate_clean'] for r in art['rows']); \
+	assert all(math.isfinite(r[k]) for r in art['rows'] for k in \
+	('dp_time_s',)), art; \
+	assert all(math.isfinite(r[g][k]) and r[g][k] > 0 \
+	for r in art['rows'] for g in ('flat','decomposed') \
+	for k in ('best_time_s','speedup_vs_dp')), art; \
+	print('searchscale-smoke: SEARCH_r01 ok:', \
+	{'headline': art['headline'], \
+	'speedup_vs_dp': head['decomposed']['speedup_vs_dp'], \
+	'vs_flat': head['decomposed_vs_flat'], \
+	'memo_hits': head['decomposed']['memo_hits'], \
+	'sizes': [r['size'] for r in art['rows']]})"
 
 # MFU-waterfall smoke (observability): tiny CNN with sampled op timing +
 # live metrics export; asserts the step_budget bucket invariant, a

@@ -102,6 +102,12 @@ def parse_args(argv) -> RnnConfig:
             cfg.min_devices = int(val())
         elif a == "--research-budget-s":
             cfg.research_budget_s = float(val())
+        elif a == "--decompose":
+            cfg.decompose = True
+        elif a == "--block-budget-s":
+            cfg.block_budget_s = float(val())
+        elif a == "--boundary-refine-iters":
+            cfg.boundary_refine_iters = int(val())
         elif a == "--max-regrows":
             cfg.max_regrows = int(val())
         elif a == "--regrow-probes":

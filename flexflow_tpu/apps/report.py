@@ -13,6 +13,8 @@
         [--time-field T] [--json]
     python -m flexflow_tpu.apps.report fleet <run.jsonl|obs_dir ...> \\
         [--json] [--trace OUT.trace.json]
+    python -m flexflow_tpu.apps.report search <run.jsonl|obs_dir ...> \\
+        [--json]
 
 Default mode renders a run's JSONL event stream (FFConfig.obs_dir /
 RunLog output, a search-trace artifact, or a bench log) into the summary
@@ -69,6 +71,13 @@ decompositions, packings and rebalances, the device-second
 utilization account (with its exact busy+idle+resizing == capacity
 invariant re-checked), and fleetsim sweep points.  ``--trace``
 exports the lifecycle/flow/pool-util Perfetto lanes.
+
+The ``search`` subcommand renders a strategy-search run's records
+(apps/search.py / apps/searchscale.py -obs-dir, or the
+``.trace.jsonl`` written next to a saved strategy): candidate space,
+plan gate, best-cost trajectory, the decomposed path's per-block
+sub-searches and stitch account (``search_block`` /
+``search_stitch``), and the winning plan's per-op cost breakdown.
 """
 
 from __future__ import annotations
@@ -437,6 +446,39 @@ def fleet_main(argv, log=print) -> int:
     return 0
 
 
+def search_main(argv, log=print) -> int:
+    """The search pass (``report search``): render a strategy-search
+    run's records — the candidate space, pre-sim plan gate, flat-MCMC
+    best-cost trajectory, and (for ``--decompose`` runs) the per-block
+    sub-searches (``search_block``: searched vs memo-replayed, with
+    acceptance and per-block best cost), the stitch account
+    (``search_stitch``: boundary ops, regrid seconds, refinement,
+    budget hit), the final result, and the winning plan's per-op cost
+    breakdown.  ``--json`` emits summarize()'s ``search`` object.
+    Exit 1 when the stream carries no search records."""
+    from flexflow_tpu.obs.report import _search_section, summarize
+
+    json_out = "--json" in argv
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        log(search_main.__doc__.strip())
+        return 2
+    events, _ = _read_paths(paths, log)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    if json_out:
+        s = summarize(events).get("search")
+        log(json.dumps(s or {}))
+        return 0 if s else 1
+    lines = _search_section(events)
+    if not lines:
+        log("no search records in the stream(s): run apps/search.py "
+            "or apps/searchscale.py with -obs-dir set (or point at "
+            "the .trace.jsonl written next to a saved strategy)")
+        return 1
+    log("\n".join(lines))
+    return 0
+
+
 def slo_main(argv, log=print) -> int:
     """The SLO pass (``report slo``): evaluate a latency SLO over the
     stream's ``serve_request`` records — whole-stream + worst-window
@@ -523,6 +565,8 @@ def main(argv=None, log=print) -> int:
         return slo_main(argv[1:], log)
     if argv and argv[0] == "fleet":
         return fleet_main(argv[1:], log)
+    if argv and argv[0] == "search":
+        return search_main(argv[1:], log)
     json_out = "--json" in argv
     paths = [a for a in argv if not a.startswith("-")]
     if not paths or "-h" in argv or "--help" in argv:

@@ -153,6 +153,17 @@ class FFConfig:
     min_devices: int = 1
     research_budget_s: float = 30.0
     elastic_search_iters: int = 2000
+    # decomposed strategy search (round 19): --decompose makes every
+    # re-search (elastic recovery included) run the block-decomposed
+    # path — per-layer sub-searches with shared-block memoization and a
+    # boundary-refinement pass.  --research-budget-s then caps the
+    # TOTAL wall across all sub-searches (one shared deadline), while
+    # --block-budget-s additionally caps each sub-search (0 = proposal-
+    # count bound only); --boundary-refine-iters reserves proposals for
+    # the post-stitch refinement pass (0 = 20% of the budget).
+    decompose: bool = False
+    block_budget_s: float = 0.0
+    boundary_refine_iters: int = 0
     # elastic re-expansion (round 9): after a shrink, previously-dead
     # ordinals are probed at existing boundaries; --regrow-probes
     # consecutive healthy probes trigger recover_grow (debounce), and a
@@ -332,6 +343,12 @@ class FFConfig:
                 cfg.research_budget_s = float(val())
             elif a == "--elastic-search-iters":
                 cfg.elastic_search_iters = int(val())
+            elif a == "--decompose":
+                cfg.decompose = True
+            elif a == "--block-budget-s":
+                cfg.block_budget_s = float(val())
+            elif a == "--boundary-refine-iters":
+                cfg.boundary_refine_iters = int(val())
             elif a == "--max-regrows":
                 cfg.max_regrows = int(val())
             elif a == "--regrow-probes":

@@ -9,6 +9,8 @@ from flexflow_tpu.models.inception import (add_inception_v3_layers,
 from flexflow_tpu.models.resnet import add_resnet101_layers, build_resnet101
 from flexflow_tpu.models.densenet import (add_densenet121_layers,
                                           build_densenet121)
+from flexflow_tpu.models.gpt import (GPT_SIZES, build_gpt, gpt_config,
+                                     gpt_param_count)
 
 __all__ = [
     "add_alexnet_layers", "build_alexnet",
@@ -16,4 +18,5 @@ __all__ = [
     "add_inception_v3_layers", "build_inception_v3",
     "add_resnet101_layers", "build_resnet101",
     "add_densenet121_layers", "build_densenet121",
+    "GPT_SIZES", "build_gpt", "gpt_config", "gpt_param_count",
 ]

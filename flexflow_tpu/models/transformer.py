@@ -70,6 +70,10 @@ class TransformerConfig:
     elastic: bool = False
     min_devices: int = 1
     research_budget_s: float = 30.0
+    # decomposed re-search (round 19, forwarded to FFConfig)
+    decompose: bool = False
+    block_budget_s: float = 0.0
+    boundary_refine_iters: int = 0
     ckpt_async: bool = False
     # elastic re-expansion / graceful drain / step watchdog (round 9)
     max_regrows: int = 1
@@ -119,6 +123,9 @@ class TransformerLM(FFModel):
             elastic=self.t.elastic,
             min_devices=self.t.min_devices,
             research_budget_s=self.t.research_budget_s,
+            decompose=self.t.decompose,
+            block_budget_s=self.t.block_budget_s,
+            boundary_refine_iters=self.t.boundary_refine_iters,
             ckpt_async=self.t.ckpt_async,
             max_regrows=self.t.max_regrows,
             regrow_probes=self.t.regrow_probes,

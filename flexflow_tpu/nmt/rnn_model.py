@@ -81,6 +81,10 @@ class RnnConfig:
     elastic: bool = False
     min_devices: int = 1
     research_budget_s: float = 30.0
+    # decomposed re-search (round 19, forwarded to FFConfig)
+    decompose: bool = False
+    block_budget_s: float = 0.0
+    boundary_refine_iters: int = 0
     ckpt_async: bool = False
     # elastic re-expansion / graceful drain / step watchdog (round 9)
     max_regrows: int = 1
@@ -190,6 +194,9 @@ class RnnModel(FFModel):
             elastic=self.rnn.elastic,
             min_devices=self.rnn.min_devices,
             research_budget_s=self.rnn.research_budget_s,
+            decompose=self.rnn.decompose,
+            block_budget_s=self.rnn.block_budget_s,
+            boundary_refine_iters=self.rnn.boundary_refine_iters,
             ckpt_async=self.rnn.ckpt_async,
             max_regrows=self.rnn.max_regrows,
             regrow_probes=self.rnn.regrow_probes,

@@ -331,10 +331,12 @@ def _search_section(events: List[Dict]) -> List[str]:
     space = [e for e in events if e.get("kind") == "search_space"]
     gates = [e for e in events if e.get("kind") == "plan_gate"]
     chunks = [e for e in events if e.get("kind") == "search_chunk"]
+    blocks = [e for e in events if e.get("kind") == "search_block"]
+    stitches = [e for e in events if e.get("kind") == "search_stitch"]
     results = [e for e in events if e.get("kind") == "search_result"]
     breakdown = [e for e in events if e.get("kind") == "search_breakdown"]
     pipes = [e for e in events if e.get("kind") == "pipeline_decision"]
-    if not (space or gates or chunks or results):
+    if not (space or gates or chunks or blocks or stitches or results):
         return []
     lines = ["== strategy search =="]
     for s in space:
@@ -365,6 +367,35 @@ def _search_section(events: List[Dict]) -> List[str]:
             f"  acceptance: {acc}/{prop} "
             f"({100.0 * acc / prop if prop else 0.0:.1f}%)"
             + (f", {sum(pps) / len(pps):,.0f} proposals/s" if pps else ""))
+    if blocks:
+        searched = [b for b in blocks if not b.get("memo")]
+        memoed = [b for b in blocks if b.get("memo")]
+        lines.append(
+            f"  blocks: {len(blocks)} ({len(searched)} searched, "
+            f"{len(memoed)} memo replays)")
+        for b in searched[:12]:
+            reps = b.get("repeats", 1)
+            lines.append(
+                f"    {str(b.get('block', '?')):<14s} "
+                f"{b.get('ops', '?'):>3} ops"
+                + (f" x{reps:<3d}" if reps and reps > 1 else "     ")
+                + f" {b.get('accepted', 0)}/{b.get('proposed', 0)} "
+                f"accepted -> {_fmt_s(b.get('best_time_s') or 0.0)}")
+        if len(searched) > 12:
+            lines.append(f"    ... {len(searched) - 12} more searched "
+                         f"block(s)")
+    for st in stitches:
+        lines.append(
+            f"  stitch: {st.get('blocks', '?')} blocks "
+            f"({st.get('unique_blocks', '?')} unique, "
+            f"{st.get('memo_hits', 0)} memo hits) -> "
+            f"{_fmt_s(st.get('stitched_time_s', 0.0))}, "
+            f"{st.get('boundary_ops', 0)} boundary ops "
+            f"(regrid {_fmt_s(st.get('boundary_regrid_s', 0.0))}), "
+            f"refine {st.get('refined_proposed', 0)}/"
+            f"{st.get('refine_iters', 0)} -> "
+            f"{_fmt_s(st.get('best_time_s', 0.0))}"
+            + (" [budget hit]" if st.get("budget_hit") else ""))
     for r in results:
         lines.append(
             f"  result: dp {_fmt_s(r.get('dp_time_s', 0.0))}, "
@@ -873,8 +904,10 @@ def summarize(events: Iterable[Dict]) -> Dict:
     space = [e for e in events if e.get("kind") == "search_space"]
     gates = [e for e in events if e.get("kind") == "plan_gate"]
     chunks = [e for e in events if e.get("kind") == "search_chunk"]
+    blocks = [e for e in events if e.get("kind") == "search_block"]
+    stitches = [e for e in events if e.get("kind") == "search_stitch"]
     results = [e for e in events if e.get("kind") == "search_result"]
-    if space or gates or chunks or results:
+    if space or gates or chunks or blocks or stitches or results:
         se: Dict = {}
         if space:
             se["space"] = {k: space[-1].get(k) for k in
@@ -893,6 +926,23 @@ def summarize(events: Iterable[Dict]) -> Dict:
             if curve:
                 se["best_time_s"] = {"first": curve[0], "last": curve[-1]}
             se["accept_rate"] = acc / prop if prop else 0.0
+        if blocks:
+            searched = [b for b in blocks if not b.get("memo")]
+            se["blocks"] = {
+                "total": len(blocks),
+                "searched": len(searched),
+                "memo_replays": len(blocks) - len(searched),
+                "proposed": sum(b.get("proposed", 0) for b in blocks),
+                "accepted": sum(b.get("accepted", 0) for b in blocks),
+            }
+        if stitches:
+            st = stitches[-1]
+            se["stitch"] = {k: st.get(k) for k in
+                            ("blocks", "unique_blocks", "memo_hits",
+                             "boundary_ops", "boundary_regrid_s",
+                             "refine_iters", "refined_proposed",
+                             "stitched_time_s", "best_time_s",
+                             "dp_time_s", "budget_hit")}
         if results:
             r = results[-1]
             se["result"] = {k: r.get(k) for k in

@@ -243,6 +243,63 @@ class NativeSimulator:
     def delta_state(self) -> DeltaState:
         return DeltaState(self)
 
+    def masked_mcmc(self, assignment: Sequence[int], free_ops,
+                    n_cands, iters: int, beta: float = 5e3, seed: int = 0,
+                    deadline: float = None):
+        """Metropolis chain restricted to ``free_ops`` on the FULL graph:
+        every op outside the mask keeps its config in ``assignment``, so
+        boundary edges into/out of the masked block are priced by the same
+        delta re-simulation as interior edges (no separate boundary cost
+        model can drift from the simulator).  This is the block sub-search
+        primitive of the decomposed search (round 19) — a caller-driven
+        loop over :class:`DeltaState` rather than a new native entry
+        point, deterministic under ``seed`` via numpy's RandomState.
+
+        ``n_cands`` maps op index -> candidate count (list or dict);
+        ``deadline`` is an absolute ``time.perf_counter()`` cutoff checked
+        every 64 proposals (None = run all ``iters`` — the bit-reproducible
+        mode; the elastic path passes a shared deadline so one wall budget
+        caps the TOTAL across sub-searches).
+
+        Returns ``(best, best_t, cur, cur_t, stats)`` with stats keyed
+        like the native chains (accepted/proposed/delta_evals/full_evals).
+        """
+        import math as _math
+        import time as _time
+
+        rng = np.random.RandomState(int(seed) & 0xFFFFFFFF)
+        cur = np.ascontiguousarray(assignment, dtype=np.int32).copy()
+        assert len(cur) == self.n_ops
+        free = [int(i) for i in free_ops if int(n_cands[int(i)]) > 1]
+        ds = self.delta_state()
+        cur_t = float(ds.init(cur))
+        best, best_t = cur.copy(), cur_t
+        stats = {"accepted": 0, "proposed": 0, "delta_evals": 0,
+                 "full_evals": 1}
+        if free:
+            for it in range(int(iters)):
+                if deadline is not None and (it & 63) == 0 \
+                        and _time.perf_counter() >= deadline:
+                    break
+                op = free[int(rng.randint(len(free)))]
+                k = int(n_cands[op])
+                cfg = int(rng.randint(k - 1))
+                if cfg >= int(cur[op]):
+                    cfg += 1   # uniform over the k-1 OTHER configs
+                t = float(ds.propose(op, cfg))
+                stats["proposed"] += 1
+                stats["delta_evals"] += 1
+                if t <= cur_t or float(rng.random_sample()) \
+                        < _math.exp(-beta * (t - cur_t)):
+                    ds.commit()
+                    cur[op] = cfg
+                    cur_t = t
+                    stats["accepted"] += 1
+                    if t < best_t:
+                        best, best_t = cur.copy(), t
+        return (best.tolist(), float(best_t), cur.tolist(), float(cur_t),
+                stats)
+
     def mcmc_chains(self, assignment: Sequence[int], iters: int = 250_000,
                     beta: float = 5e3, seed: int = 0, chains: int = 4,
                     exchange_every: int = 0):

@@ -14,6 +14,7 @@ communication, exactly like Legion derives copies."""
 from __future__ import annotations
 
 import math
+import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.machine import MachineModel
@@ -417,7 +418,299 @@ class _InputSource(Op):
         return P("n")
 
 
-class StrategySearch:
+# layer-name prefix the transformer builder emits (``blk{i}_attn`` ...);
+# generalized so any model that labels repeated stages ``<word><idx>_``
+# partitions the same way
+_BLOCK_RE = re.compile(r"^([A-Za-z]+\d+)_")
+
+
+class _Block:
+    """One contiguous partition of the op graph (decomposed search)."""
+
+    __slots__ = ("name", "indices")
+
+    def __init__(self, name: str, indices: List[int]):
+        self.name = name
+        self.indices = indices
+
+
+# ops per fallback chunk when the graph carries no ``blkN_`` labels (CNNs,
+# NMT): contiguous topological segments — coarse, but the decomposition
+# still bounds each sub-search's move space
+_FALLBACK_CHUNK = 32
+
+
+def partition_blocks(ops: Sequence[Op]) -> List[_Block]:
+    """Partition the search's op list (input sources included) into
+    contiguous blocks by the ``blk{i}_*`` name prefixes the transformer
+    builder emits: everything before the first labeled op is the
+    ``stem`` (inputs, embeddings), everything after the last is the
+    ``head`` (final LN, vocab projection, loss).  Unlabeled graphs fall
+    back to fixed-size contiguous chunks.  Ops arrive in build
+    (topological) order, so every block is a contiguous schedule
+    segment and the stitch order is well-defined."""
+    labels = []
+    any_labeled = False
+    for op in ops:
+        m = _BLOCK_RE.match(op.name)
+        labels.append(m.group(1) if m else None)
+        any_labeled = any_labeled or bool(m)
+    blocks: List[_Block] = []
+    if not any_labeled:
+        for lo in range(0, len(ops), _FALLBACK_CHUNK):
+            idx = list(range(lo, min(lo + _FALLBACK_CHUNK, len(ops))))
+            blocks.append(_Block(f"chunk{len(blocks)}", idx))
+        return blocks
+    last_labeled = max(i for i, l in enumerate(labels) if l)
+    cur_name, cur_idx = None, []
+    for i, l in enumerate(labels):
+        if l is None:
+            name = "stem" if not blocks and cur_name is None else \
+                ("head" if i > last_labeled else cur_name or "stem")
+        else:
+            name = l
+        if name != cur_name and cur_idx:
+            blocks.append(_Block(cur_name, cur_idx))
+            cur_idx = []
+        cur_name = name
+        cur_idx.append(i)
+    if cur_idx:
+        blocks.append(_Block(cur_name, cur_idx))
+    return blocks
+
+
+class StrategySearchDecomposedMixin:
+    """Block-decomposed search (round 19): partition, fingerprint-keyed
+    shared-block memoization, masked per-block sub-searches on the full
+    graph, stitch, boundary refinement.  Mixed into
+    :class:`StrategySearch` below (kept separate only for readability —
+    the methods use the search's ops/candidates/sim state directly)."""
+
+    def partition_blocks(self) -> List[_Block]:
+        return partition_blocks(self.ops)
+
+    def block_fingerprint(self, indices: Sequence[int]) -> str:
+        """Structural fingerprint of a block: per op — kind, output
+        shape, param bytes, the FULL candidate list (dims + device
+        maps), and producer topology (block-internal producers by local
+        position, external ones by kind + shape).  Two blocks with equal
+        fingerprints have positionally identical candidate lists, so a
+        sub-search result transfers as a candidate-index copy — the
+        memoization that makes depth ~free (N identical layers cost one
+        sub-search)."""
+        import hashlib
+
+        local = {gi: li for li, gi in enumerate(indices)}
+        parts = []
+        for i in indices:
+            op = self.ops[i]
+            cands = tuple((tuple(pc.dims), tuple(pc.devices))
+                          for pc in self.candidates[i])
+            prods = []
+            for t in op.inputs:
+                p = self._op_index.get(t.tid, -1)
+                if p in local:
+                    prods.append(("in", local[p]))
+                else:
+                    po = self.ops[p] if 0 <= p < len(self.ops) else None
+                    prods.append((
+                        "ext",
+                        type(po).__name__ if po is not None else "none",
+                        tuple(po.output.shape) if po is not None else ()))
+            parts.append((type(op).__name__, tuple(op.output.shape),
+                          float(op.param_bytes()), cands, tuple(prods)))
+        return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
+
+    def _boundary_ops(self, blocks: List[_Block],
+                      assignment: Sequence[int]):
+        """Ops on cross-block edges (the refinement pass's move set) and
+        the total regrid price of those edges under ``assignment`` —
+        the regrid planner's cost view of the stitch
+        (:func:`flexflow_tpu.verify.plan.regrid_edge_cost`)."""
+        from flexflow_tpu.verify.plan import regrid_edge_cost
+
+        block_of = {}
+        for b in blocks:
+            for i in b.indices:
+                block_of[i] = b.name
+        boundary = set()
+        regrid_s = 0.0
+        for i, op in enumerate(self.ops):
+            for t in op.inputs:
+                p = self._op_index.get(t.tid, -1)
+                if p < 0 or block_of.get(p) == block_of.get(i):
+                    continue
+                boundary.add(i)
+                if not isinstance(self.ops[p], _InputSource):
+                    boundary.add(p)
+                regrid_s += regrid_edge_cost(
+                    t.shape, self.candidates[p][assignment[p]],
+                    self.candidates[i][assignment[i]], self.machine)
+        return sorted(boundary), regrid_s
+
+    def search_decomposed(self, iters: int = 250_000, beta: float = 5e3,
+                          seed: int = 0, delta: bool = True,
+                          start: Optional[Sequence[int]] = None,
+                          budget_s: Optional[float] = None,
+                          block_budget_s: Optional[float] = None,
+                          boundary_refine_iters: int = 0):
+        """Decomposed MCMC at an EQUAL proposal budget to :meth:`search`:
+        ``iters`` total proposals are split ~80/20 between per-block
+        sub-searches and a global boundary-refinement pass, so flat vs
+        decomposed comparisons (SEARCH_r01.json) spend the same budget.
+
+        Each unique block fingerprint gets ONE masked sub-search
+        (:meth:`NativeSimulator.masked_mcmc` — Metropolis restricted to
+        the block's ops on the FULL graph, so boundary edges are priced
+        by the same delta re-simulation as interior ones), warm-started
+        from the assignment the previous blocks left behind; repeated
+        blocks take the result as a positional candidate-index copy
+        (``memo_hits``).  The refinement pass then frees exactly the
+        ops on cross-block edges.
+
+        Budgets: ``budget_s`` is the TOTAL wall budget — one absolute
+        deadline threads through every sub-search and the refinement
+        (the elastic/fleet ``--research-budget-s`` contract: N blocks
+        never multiply the budget N-fold).  ``block_budget_s``
+        additionally caps each sub-search.  Both default off — the
+        bit-reproducible mode, where only the proposal counts bind.
+
+        Emits one ``search_block`` obs record per block (memo copies
+        included), one ``search_stitch``, then the standard
+        ``search_result``/``search_breakdown``.  Returns (strategy,
+        info) shaped like :meth:`search` plus the decomposition keys
+        (blocks/unique_blocks/memo_hits/stitched_time/...)."""
+        import time as _time
+
+        t_start = _time.perf_counter()
+        dp = self.dp_assignment()
+        dp_time = self.simulate(dp)
+        cur = list(start) if start is not None else list(dp)
+        if len(cur) != len(self.ops):
+            raise ValueError(
+                f"warm-start assignment has {len(cur)} entries for "
+                f"{len(self.ops)} ops")
+        self.sim.set_delta(delta)
+        blocks = self.partition_blocks()
+        n_cands = [len(c) for c in self.candidates]
+        deadline = None if budget_s is None \
+            else t_start + float(budget_s)
+        groups: Dict[str, List[int]] = {}
+        for bi, b in enumerate(blocks):
+            groups.setdefault(self.block_fingerprint(b.indices),
+                              []).append(bi)
+        order = sorted(groups.values(), key=lambda g: g[0])
+        refine_iters = int(boundary_refine_iters) if boundary_refine_iters \
+            else max(int(iters) // 5, 0)
+        block_pool = max(int(iters) - refine_iters, 0)
+        n_groups = len(order)
+        tot_prop = tot_acc = 0
+        memo_hits = 0
+        budget_hit = False
+        for gi, group in enumerate(order):
+            g_iters = block_pool // n_groups \
+                + (1 if gi < block_pool % n_groups else 0)
+            rep = blocks[group[0]]
+            if deadline is not None and _time.perf_counter() >= deadline:
+                budget_hit = True
+                g_iters = 0
+            bl_deadline = deadline
+            if block_budget_s is not None:
+                d2 = _time.perf_counter() + float(block_budget_s)
+                bl_deadline = d2 if bl_deadline is None \
+                    else min(bl_deadline, d2)
+            t0 = _time.perf_counter()
+            st = {"proposed": 0, "accepted": 0}
+            best_t = None
+            if g_iters > 0:
+                best, best_t, _cur, _cur_t, st = self.sim.masked_mcmc(
+                    cur, rep.indices, n_cands, g_iters, beta=beta,
+                    seed=seed * 1_000_003 + gi, deadline=bl_deadline)
+                cur = list(best)
+                tot_prop += st["proposed"]
+                tot_acc += st["accepted"]
+            wall = _time.perf_counter() - t0
+            self.obs.event(
+                "search_block", block=rep.name, ops=len(rep.indices),
+                group=gi, repeats=len(group), iters=g_iters,
+                proposed=st["proposed"], accepted=st["accepted"],
+                best_time_s=(best_t + self._opt_stream_s)
+                if best_t is not None else None,
+                wall_s=wall, memo=False)
+            for other_bi in group[1:]:
+                other = blocks[other_bi]
+                for src_i, dst_i in zip(rep.indices, other.indices):
+                    cur[dst_i] = cur[src_i]
+                memo_hits += 1
+                self.obs.event(
+                    "search_block", block=other.name,
+                    ops=len(other.indices), group=gi,
+                    repeats=len(group), iters=0, proposed=0, accepted=0,
+                    best_time_s=None, wall_s=0.0, memo=True,
+                    memo_from=rep.name)
+        stitched_time = self.simulate(cur)
+        boundary, regrid_s = self._boundary_ops(blocks, cur)
+        refined = 0
+        if refine_iters > 0 and boundary and not (
+                deadline is not None
+                and _time.perf_counter() >= deadline):
+            best, _bt, _c, _ct, st = self.sim.masked_mcmc(
+                cur, boundary, n_cands, refine_iters, beta=beta,
+                seed=seed * 1_000_003 + n_groups + 17, deadline=deadline)
+            cur = list(best)
+            refined = st["proposed"]
+            tot_prop += st["proposed"]
+            tot_acc += st["accepted"]
+        elif deadline is not None and _time.perf_counter() >= deadline:
+            budget_hit = True
+        best_time = self.simulate(cur)
+        tot_wall = _time.perf_counter() - t_start
+        self.obs.event(
+            "search_stitch", blocks=len(blocks), unique_blocks=n_groups,
+            memo_hits=memo_hits, boundary_ops=len(boundary),
+            boundary_regrid_s=regrid_s, refine_iters=refine_iters,
+            refined_proposed=refined, stitched_time_s=stitched_time,
+            best_time_s=best_time, dp_time_s=dp_time,
+            proposed=tot_prop, budget_hit=budget_hit, wall_s=tot_wall)
+        info = {
+            "dp_time": dp_time,
+            "best_time": best_time,
+            "speedup_vs_dp": dp_time / best_time if best_time else 1.0,
+            "assignment": cur,
+            "accept_rate": tot_acc / tot_prop if tot_prop else 0.0,
+            "proposals_per_sec": tot_prop / tot_wall
+            if tot_wall > 0 else 0.0,
+            "iters_done": tot_prop,
+            "budget_hit": budget_hit,
+            "decomposed": True,
+            "blocks": len(blocks),
+            "unique_blocks": n_groups,
+            "memo_hits": memo_hits,
+            "boundary_ops": len(boundary),
+            "boundary_regrid_s": regrid_s,
+            "stitched_time": stitched_time,
+            "wall_s": tot_wall,
+        }
+        result = {"dp_time_s": dp_time, "best_time_s": best_time,
+                  "speedup_vs_dp": info["speedup_vs_dp"],
+                  "iters": tot_prop, "budget_hit": budget_hit,
+                  "accepted": tot_acc, "proposed": tot_prop,
+                  "accept_rate": info["accept_rate"], "seed": seed,
+                  "beta": beta, "chains": 1, "delta": delta,
+                  "delta_hit_rate": 1.0 if tot_prop else 0.0,
+                  "proposals_per_sec": info["proposals_per_sec"],
+                  "decomposed": True, "blocks": len(blocks),
+                  "unique_blocks": n_groups, "memo_hits": memo_hits,
+                  "stitched_time_s": stitched_time,
+                  "cost_cache": {"hits": self.cost_model.cache_hits,
+                                 "misses": self.cost_model.cache_misses}}
+        self.obs.event("search_result", **result)
+        if self.obs.enabled:
+            self._emit_breakdown(cur)
+        return self.assignment_to_strategy(cur), info
+
+
+class StrategySearch(StrategySearchDecomposedMixin):
     """Closed loop: model -> candidates -> cost tables -> native sim ->
     MCMC -> Strategy (executable + serializable)."""
 

@@ -324,7 +324,9 @@ def research_strategy(config, rebuild, new_machine, old_strategy,
     Degrades gracefully: when the native simulator (or the search
     itself) is unavailable, the mesh trains pure-DP — a correct plan,
     just not a searched one.  Returns ``(Strategy, info dict)``;
-    ``info["mode"]`` is ``"mcmc"`` or ``"dp_fallback"``.
+    ``info["mode"]`` is ``"mcmc"``, ``"mcmc_decomposed"`` (when
+    ``--decompose`` is set — the budget then caps the TOTAL across all
+    block sub-searches), or ``"dp_fallback"``.
 
     ``objective`` is forwarded to :class:`StrategySearch` — the serving
     autoscaler (serve/engine.py) re-searches its resized mesh under
@@ -350,6 +352,30 @@ def research_strategy(config, rebuild, new_machine, old_strategy,
             and len(fallback_strategy) else None
         start = warm_assignment(ss, warm, fallback=warm_fb) \
             if warm is not None or warm_fb is not None else None
+        if getattr(config, "decompose", False):
+            # block-decomposed re-search (round 19): budget_s is the
+            # TOTAL wall across every block sub-search plus the
+            # boundary refinement — one shared deadline, so
+            # --research-budget-s means the same thing it does for the
+            # flat path (a cap on the whole recovery re-search, not a
+            # per-block allowance that multiplies with depth)
+            strategy, info = ss.search_decomposed(
+                iters=iters, seed=int(getattr(config, "seed", 0)),
+                delta=getattr(config, "search_delta", "on") != "off",
+                start=start, budget_s=budget,
+                block_budget_s=getattr(config, "block_budget_s", 0.0)
+                or None,
+                boundary_refine_iters=int(getattr(
+                    config, "boundary_refine_iters", 0)))
+            return strategy, {"mode": "mcmc_decomposed",
+                              "best_time_s": info.get("best_time"),
+                              "iters": info.get("iters_done"),
+                              "budget_hit": info.get("budget_hit",
+                                                     False),
+                              "budget_s": budget,
+                              "blocks": info.get("blocks"),
+                              "memo_hits": info.get("memo_hits"),
+                              "objective": objective}
         strategy, info = ss.search(
             iters=iters, seed=int(getattr(config, "seed", 0)),
             chunks=8, chains=max(int(getattr(config, "search_chains", 1)),

@@ -565,3 +565,31 @@ def check_plan(model, strategy, machine=None, *,
               file=sys.stderr)
         raise SystemExit(2)
     return findings
+
+
+def regrid_edge_cost(tensor_shape, src_pc: ParallelConfig,
+                     dst_pc: ParallelConfig, machine,
+                     itemsize: int = 4) -> float:
+    """Price of resharding one boundary tensor from its producer's grid
+    to its consumer's grid — the regrid planner's cost view of a
+    block-stitch edge (round 19).  Uses the SAME ring formulas the
+    planner prices hops with (``parallel/regrid.py`` imports
+    ``_allreduce``/``_alltoall`` from ``sim/collectives``), so the
+    decomposed search's ``search_stitch`` record reports boundary
+    layouts in the executor's own cost terms rather than a parallel
+    model that can drift.
+
+    Equal grids cost zero; a mismatch is priced as one all-to-all of
+    the full tensor over the union of the two device sets — the upper
+    bound of the planner's hop chain (every element leaves its source
+    shard at most once)."""
+    from flexflow_tpu.sim.collectives import _alltoall
+
+    if (tuple(src_pc.dims) == tuple(dst_pc.dims)
+            and tuple(src_pc.devices) == tuple(dst_pc.devices)):
+        return 0.0
+    devs = tuple(sorted(set(src_pc.devices) | set(dst_pc.devices)))
+    if len(devs) <= 1:
+        return 0.0
+    vol = float(itemsize) * float(math.prod(tensor_shape))
+    return float(_alltoall(vol, devs, machine.topology))

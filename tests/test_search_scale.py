@@ -1,0 +1,257 @@
+"""Decomposed strategy search at 1B+-param scale (round 19).
+
+Covers the block partitioner (name-prefix blocks + contiguous-chunk
+fallback), shared-block fingerprint memoization (identical transformer
+layers get ONE sub-search; the first block legitimately differs via its
+external producer), the ``search_block`` / ``search_stitch`` obs
+records, plan-gate legality of stitched strategies at the 0.1b / 0.4b /
+1.3b presets, the decomposed-beats-flat-at-equal-budget pin, the total
+(not per-block) wall-budget semantics the elastic re-search relies on,
+and the committed SEARCH_r01.json artifact's schema / finiteness /
+acceptance pins."""
+
+import json
+import math
+import os
+
+import pytest
+
+from flexflow_tpu.machine import MachineModel, Topology
+from flexflow_tpu.models.gpt import (GPT_SIZES, build_gpt, gpt_config,
+                                     gpt_param_count)
+from flexflow_tpu.sim.search import StrategySearch, partition_blocks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: 4 layers so blk1..blk3 share a fingerprint (blk0 always differs:
+#: its external producer is the positional embed, not a residual add)
+TINY = dict(num_layers=4, d_model=128, num_heads=4, d_ff=512,
+            vocab_size=2048, seq_length=64, batch_size=16)
+
+
+def _mesh(devices):
+    return MachineModel.virtual(
+        devices, Topology(devices_per_ici_group=devices))
+
+
+def _tiny_search(machine=None, obs=None, **overrides):
+    machine = machine or _mesh(8)
+    kw = dict(TINY)
+    kw.update(overrides)
+    model = build_gpt("0.1b", machine, **kw)
+    return model, StrategySearch(model, machine, obs=obs)
+
+
+def test_partition_blocks_by_name_prefix():
+    _, search = _tiny_search()
+    blocks = search.partition_blocks()
+    names = [b.name for b in blocks]
+    assert names == ["stem", "blk0", "blk1", "blk2", "blk3", "head"]
+    # a partition: disjoint, contiguous, covering every op exactly once
+    seen = [i for b in blocks for i in b.indices]
+    assert seen == list(range(len(search.ops)))
+    by_name = {b.name: b for b in blocks}
+    stem_kinds = {type(search.ops[i]).__name__
+                  for i in by_name["stem"].indices}
+    assert any("Embed" in k or "Input" in k for k in stem_kinds)
+    head_ops = {search.ops[i].name for i in by_name["head"].indices}
+    assert "lm_head" in head_ops and "softmax" in head_ops
+
+
+def test_partition_fallback_contiguous_chunks():
+    from flexflow_tpu.apps.search import build_model
+
+    machine = _mesh(8)
+    model = build_model("alexnet", machine, 64)
+    search = StrategySearch(model, machine)
+    blocks = partition_blocks(search.ops)   # no blkN_ name prefixes
+    assert all(b.name.startswith("chunk") for b in blocks)
+    seen = [i for b in blocks for i in b.indices]
+    assert seen == list(range(len(search.ops)))
+    assert all(len(b.indices) <= 32 for b in blocks)
+
+
+def test_fingerprint_memoization_groups_identical_layers():
+    _, search = _tiny_search()
+    blocks = search.partition_blocks()
+    by_name = {b.name: b for b in blocks}
+    fp = {n: search.block_fingerprint(b.indices)
+          for n, b in by_name.items()}
+    # blk1..blk3 are structurally identical -> ONE fingerprint
+    assert fp["blk1"] == fp["blk2"] == fp["blk3"]
+    # blk0's external producer differs (pos-embed vs residual add), and
+    # stem/head are their own shapes — distinct blocks are NOT merged
+    assert fp["blk0"] != fp["blk1"]
+    assert len({fp["stem"], fp["blk0"], fp["blk1"], fp["head"]}) == 4
+
+
+def test_decomposed_search_emits_block_and_stitch_records(tmp_path):
+    from flexflow_tpu import obs
+
+    path = str(tmp_path / "run.jsonl")
+    olog = obs.RunLog(path, surface="search", meta={"app": "test"})
+    _, search = _tiny_search(obs=olog)
+    strategy, info = search.search_decomposed(iters=1200, seed=0)
+    olog.close()
+    events = list(obs.read_run(path))
+    blocks = [e for e in events if e.get("kind") == "search_block"]
+    stitch = [e for e in events if e.get("kind") == "search_stitch"]
+    assert len(blocks) == info["blocks"] == 6
+    memo = [b for b in blocks if b["memo"]]
+    assert len(memo) == info["memo_hits"] == 2
+    # memo replays burn ZERO proposals and name their source
+    assert all(b["proposed"] == 0 and b["memo_from"] == "blk1"
+               for b in memo)
+    searched = [b for b in blocks if not b["memo"]]
+    assert sum(b["proposed"] for b in searched) > 0
+    [st] = stitch
+    assert st["blocks"] == 6 and st["unique_blocks"] == 4
+    assert st["memo_hits"] == 2 and st["boundary_ops"] > 0
+    assert st["best_time_s"] == pytest.approx(info["best_time"])
+    # the report CLI renders and summarizes the same stream
+    from flexflow_tpu.obs.report import render, summarize
+
+    text = render(events)
+    assert "memo replays" in text and "stitch:" in text
+    s = summarize(events)["search"]
+    assert s["blocks"]["memo_replays"] == 2
+    assert s["stitch"]["unique_blocks"] == 4
+
+
+@pytest.mark.parametrize("size", ["0.1b", "0.4b", "1.3b"])
+def test_stitched_strategy_passes_plan_gate(size):
+    from flexflow_tpu.verify.plan import plan_findings
+
+    machine = _mesh(16)
+    model = build_gpt(size, machine)
+    search = StrategySearch(model, machine)
+    strategy, info = search.search_decomposed(iters=1500, seed=0)
+    assert info["best_time"] <= info["dp_time"] * (1 + 1e-9)
+    assert info["memo_hits"] >= 1
+    findings, summary = plan_findings(model, strategy, machine)
+    errors = [f for f in findings
+              if f.severity == "error" and not f.exempted]
+    assert errors == [], [f"{f.code}:{f.where}" for f in errors]
+    assert summary["ops"] == len(model.layers)
+
+
+def test_gpt_presets_reach_1b_params():
+    big = {s for s, kw in GPT_SIZES.items()
+           if gpt_param_count(gpt_config(s)) > 1_000_000_000}
+    assert "1.3b" in big and "1.3b-deep" in big
+    with pytest.raises(KeyError):
+        gpt_config("7b")
+
+
+def test_decomposed_beats_flat_at_equal_budget():
+    machine = _mesh(16)
+    model = build_gpt("0.1b", machine)
+    search = StrategySearch(model, machine)
+    _, flat = search.search(iters=4000, seed=0)
+    _, dec = search.search_decomposed(iters=4000, seed=0)
+    assert dec["best_time"] < flat["best_time"]
+    assert dec["speedup_vs_dp"] > 1.0
+    assert dec["memo_hits"] >= 1
+
+
+def test_decomposed_bit_reproducible():
+    _, s1 = _tiny_search()
+    _, s2 = _tiny_search()
+    _, a = s1.search_decomposed(iters=1200, seed=0)
+    _, b = s2.search_decomposed(iters=1200, seed=0)
+    assert a["assignment"] == b["assignment"]
+    assert a["best_time"] == b["best_time"]
+
+
+def test_total_budget_caps_all_sub_searches():
+    # budget_s is ONE shared deadline across every block sub-search plus
+    # the refinement — not a per-block allowance that multiplies with
+    # depth.  A budget that expires immediately must stop the whole
+    # decomposed search, not just the first block.
+    import time
+
+    _, search = _tiny_search()
+    t0 = time.perf_counter()
+    _, info = search.search_decomposed(iters=10_000_000, seed=0,
+                                       budget_s=0.15)
+    wall = time.perf_counter() - t0
+    assert info["budget_hit"] is True
+    assert wall < 6.0        # nowhere near 6 blocks x the budget x many
+    assert info["best_time"] <= info["dp_time"] * (1 + 1e-9)
+
+
+def test_elastic_research_uses_decomposed_total_budget():
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+    from flexflow_tpu.utils.elastic import research_strategy
+
+    machine = _mesh(8)
+    t = TransformerConfig(decompose=True, research_budget_s=20.0,
+                          **TINY)
+    model = TransformerLM(t, machine)
+    assert model.config.decompose is True   # forwarded into FFConfig
+
+    def rebuild(shell_cfg, m):
+        return TransformerLM(TransformerConfig(**TINY), m)
+
+    strategy, info = research_strategy(model.config, rebuild, machine,
+                                       None, log=lambda *a, **k: None)
+    assert info["mode"] == "mcmc_decomposed"
+    assert info["budget_s"] == 20.0
+    assert info["memo_hits"] >= 1
+    assert len(strategy)
+
+
+def test_search_cli_flags_parse():
+    from flexflow_tpu.apps.search import parse_args
+
+    opts = parse_args(["gpt-1.3b", "--devices", "16", "--decompose",
+                       "--block-budget-s", "2.5",
+                       "--boundary-refine-iters", "500"])
+    assert opts["model"] == "gpt-1.3b"
+    assert opts["decompose"] is True
+    assert opts["block_budget_s"] == 2.5
+    assert opts["boundary_refine_iters"] == 500
+
+
+def test_search_r01_artifact_pins():
+    art = json.load(open(os.path.join(REPO, "SEARCH_r01.json")))
+    assert art["schema"] == "searchscale_bench_v1"
+    assert art["seed"] == 0
+    assert art["parsed"]["unit"] == "x_vs_dp"
+    rows = {r["size"]: r for r in art["rows"]}
+    head = rows[art["headline"]]
+    # the acceptance pins: >1B params, decomposed >= 1.15x vs DP AND
+    # strictly better than flat at the same proposal budget
+    assert head["params"] > 1_000_000_000
+    assert head["decomposed"]["speedup_vs_dp"] >= 1.15
+    assert head["decomposed"]["best_time_s"] < head["flat"]["best_time_s"]
+    assert art["parsed"]["value"] == head["decomposed"]["speedup_vs_dp"]
+    for r in art["rows"]:
+        assert r["iters"] == art["iters"]       # equal proposal budget
+        assert math.isfinite(r["dp_time_s"]) and r["dp_time_s"] > 0
+        for g in ("flat", "decomposed"):
+            assert math.isfinite(r[g]["best_time_s"])
+            assert 0 < r[g]["best_time_s"] <= r["dp_time_s"] * (1 + 1e-9)
+        assert r["decomposed"]["plan_gate_clean"] is True
+        if r["layers"] >= 3:
+            assert r["decomposed"]["memo_hits"] >= 1
+        assert len(r["decomposed"]["assignment_sha"]) == 16
+    # serving-phase plans exist at the headline scale
+    srv = head["serving"]
+    for objective in ("latency", "decode"):
+        assert srv[objective]["plan_gate_clean"] is True
+        assert math.isfinite(srv[objective]["best_time_s"])
+
+
+def test_searchscale_smoke_reproducible():
+    from flexflow_tpu.apps.searchscale import parse_args, run
+
+    opts = parse_args(["--smoke", "--iters", "1500"])
+    result = run(opts, log=lambda *a, **k: None)
+    line = result["line"]
+    assert line["repro"] is True
+    assert line["memo_hits"] >= 1
+    assert line["plan_gate_clean"] is True
+    assert line["unique_blocks"] < line["blocks"]
+    assert line["value"] >= 1.0
