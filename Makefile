@@ -4,7 +4,7 @@ PYTHON ?= python
 .PHONY: native check lint trace-smoke test bench-smoke fault-smoke \
 	budget-smoke elastic-smoke preempt-smoke rejoin-smoke fusion-smoke \
 	serve-smoke fleet-smoke loadtest-smoke disagg-smoke fleetsim-smoke \
-	searchscale-smoke
+	searchscale-smoke chaos-smoke
 
 # build the native simulator + dataloader libraries
 native:
@@ -18,7 +18,7 @@ native:
 # every emitted obs record kind must be rendered by obs/report.py and
 # covered by a test (tools/check_obs_kinds.py), and the static strategy
 # verifier must come up clean (lint)
-check: lint fusion-smoke serve-smoke disagg-smoke fleet-smoke loadtest-smoke fleetsim-smoke searchscale-smoke
+check: lint fusion-smoke serve-smoke disagg-smoke chaos-smoke fleet-smoke loadtest-smoke fleetsim-smoke searchscale-smoke
 	$(PYTHON) tools/check_fault_kinds.py
 	$(PYTHON) tools/check_flag_forwarding.py
 	$(PYTHON) tools/check_obs_kinds.py
@@ -178,6 +178,32 @@ disagg-smoke:
 	assert rec['devices'] == 8, rec; \
 	assert math.isfinite(rec['p50_s']) and math.isfinite(rec['p99_s']), rec; \
 	print('disagg-smoke ok:', {k: rec[k] for k in \
+	('completed','qps','p50_s','p99_s','devices')})"
+
+# serving-resilience smoke (chaos round): two phases on a 2x2dev
+# prefill + 2x2dev decode carve of the 8-device CPU mesh.  Equivalence:
+# the armed resilience stack (installed injector with an EMPTY spec,
+# RetryPolicy, AdmissionGate) must be byte-inert — replies and summary
+# counters bit-identical to a plain router and the single-pool engine.
+# Recovery: the seeded spec replica_crash@3 + handoff_drop@5 kills a
+# decode replica and drops a KV transfer, and every admitted request
+# must still complete with bit-identical replies via >= 1 kv_rebuild,
+# exactly 1 replica_down, >= 2 serve_retry records, zero
+# unserved/failed/shed — nothing silently lost — with a validated
+# Perfetto trace and a rendered resilience report; stdout is one JSON
+# record, exit 0
+chaos-smoke:
+	env JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m flexflow_tpu.apps.serve --chaos-smoke \
+	| $(PYTHON) -c "import json,math,sys; \
+	rec=json.loads(sys.stdin.readline()); \
+	assert sys.stdin.readline() == '', 'stdout must be one JSON line'; \
+	assert rec['completed'] == rec['requests'] == 12, rec; \
+	assert rec['unserved'] == 0 and rec['dropped'] == 0, rec; \
+	assert rec['devices'] == 8, rec; \
+	assert math.isfinite(rec['p50_s']) and math.isfinite(rec['p99_s']), rec; \
+	print('chaos-smoke ok:', {k: rec[k] for k in \
 	('completed','qps','p50_s','p99_s','devices')})"
 
 # sustained-load harness smoke (serving observability round): a small

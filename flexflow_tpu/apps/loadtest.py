@@ -33,6 +33,15 @@ the ``serve_bench_v1`` artifact (committed as ``SERVE_r01.json``) with
 the metric line under ``"parsed"`` and the full per-point sweep table.
 ``make loadtest-smoke`` asserts the line's shape, finiteness, and that
 the trace validated.
+
+``--chaos SPEC`` (implies ``--disagg``) replays the same seeded sweep
+with a FRESH deterministic fault injector per point (the
+utils/faultinject.py occurrence grammar) against the router's full
+resilience stack — bounded retries, KV re-materialization, SLO-burn
+shedding.  The artifact (committed as ``SERVE_r03.json``) gains
+per-point recovery counters and a ``vs_r02`` block proving bounded
+degradation: at every point ``completed + unserved + shed + failed ==
+offered`` — zero silently-lost requests under injected chaos.
 """
 
 from __future__ import annotations
@@ -58,7 +67,7 @@ def parse_args(argv):
         "slo_target_s": 0.25, "availability": 0.95, "slo_window_s": 2.0,
         "percentile": 99.0, "out": "", "trace": "", "obs_dir": "",
         "run_id": "", "metrics_path": "", "smoke": False,
-        "disagg": False, "baseline": "",
+        "disagg": False, "baseline": "", "chaos": "",
     }
     for a, val in flag_stream(list(argv)):
         if a in ("-n", "--requests"):
@@ -98,6 +107,13 @@ def parse_args(argv):
         elif a in ("-metrics-path", "--metrics-path"):
             opts["metrics_path"] = val()
         elif a == "--disagg":
+            opts["disagg"] = True
+        elif a == "--chaos":
+            # a utils/faultinject.py occurrence spec (e.g.
+            # "replica_crash@3,handoff_drop@5"), replayed FRESH at
+            # every sweep point against the --disagg router with the
+            # resilience stack armed; implies --disagg
+            opts["chaos"] = val()
             opts["disagg"] = True
         elif a == "--baseline":
             opts["baseline"] = val()
@@ -142,8 +158,9 @@ def _disagg_router(machine, devices, opts, olog, metrics, log):
     artifact measures.  Returns (router, carve, decode_step_ratio)."""
     from flexflow_tpu.apps.serve import _build_lm
     from flexflow_tpu.serve.engine import DEFAULT_STEP_TIME_S, ServeEngine
-    from flexflow_tpu.serve.router import ServeRouter
+    from flexflow_tpu.serve.router import AdmissionGate, ServeRouter
     from flexflow_tpu.sim.search import decode_step_ratio
+    from flexflow_tpu.utils.retry import RetryPolicy
 
     carve = _disagg_carve(devices)
     base_step = opts["step_time_s"] or DEFAULT_STEP_TIME_S
@@ -165,8 +182,18 @@ def _disagg_router(machine, devices, opts, olog, metrics, log):
     decode = [ServeEngine(dmodel, None, olog=olog, metrics=metrics,
                           log=log, step_time_s=base_step * ratio,
                           phase="decode")]
+    kw = {}
+    if opts.get("chaos"):
+        # the chaos sweep arms the full resilience stack: bounded
+        # seeded retries plus the SLO-burn admission gate built from
+        # the same SLO the sweep evaluates
+        kw = dict(retry_policy=RetryPolicy(),
+                  admission=AdmissionGate(
+                      latency_target_s=opts["slo_target_s"],
+                      availability=opts["availability"],
+                      window_s=opts["slo_window_s"]))
     return (ServeRouter(prefill, decode, olog=olog, metrics=metrics,
-                        log=log), carve, ratio)
+                        log=log, **kw), carve, ratio)
 
 
 def _sweep_point(machine, devices, opts, olog, metrics, log) -> dict:
@@ -208,7 +235,23 @@ def _sweep_point(machine, devices, opts, olog, metrics, log) -> dict:
     # per-request trace lanes stay distinct
     for i, r in enumerate(reqs):
         r.rid = devices * 100000 + i
-    summary = router.run(reqs) if opts["disagg"] else engine.run(reqs)
+    inj = None
+    if opts["disagg"] and opts.get("chaos"):
+        # a FRESH injector per sweep point: every point replays the
+        # same occurrence-indexed fault schedule, so the whole sweep
+        # is bit-reproducible under --seed + --chaos
+        from flexflow_tpu.utils.faultinject import (FaultInjector,
+                                                    install_scoped)
+
+        inj = FaultInjector(opts["chaos"], olog=olog)
+        restore = install_scoped(inj)
+        try:
+            summary = router.run(reqs)
+        finally:
+            restore()
+    else:
+        summary = router.run(reqs) if opts["disagg"] \
+            else engine.run(reqs)
 
     spec = SLOSpec(name=f"p{opts['percentile']:g}-"
                         f"{opts['slo_target_s']:g}s",
@@ -261,6 +304,31 @@ def _sweep_point(machine, devices, opts, olog, metrics, log) -> dict:
                  f"{carve['per_replica_devices']}dev prefill + "
                  f"{carve['decode_devices']}dev decode, "
                  f"step ratio {ratio:.3f}]")
+    if inj is not None:
+        accounted = summary["completed"] + summary["unserved"] \
+            + summary["shed"] + summary["failed"]
+        point.update({
+            "offered": len(reqs),
+            "shed": summary["shed"],
+            "failed": summary["failed"],
+            "retries": summary["retries"],
+            "kv_rebuilds": summary["kv_rebuilds"],
+            "replica_downs": summary["replica_down"],
+            "replicas_live": summary["replicas_live"],
+            "faults_fired": inj.fired(),
+            "recovery": {k: {kk: _round(vv) for kk, vv in d.items()}
+                         for k, d in summary["recovery"].items()},
+        })
+        assert accounted == summary["requests"] == len(reqs), \
+            (f"silent request loss at {devices} device(s): "
+             f"{accounted} accounted of {len(reqs)} offered "
+             f"({summary})")
+        shape += (f" + chaos ({inj.fired()} fault(s): "
+                  f"{summary['replica_down']} down, "
+                  f"{summary['retries']} retries, "
+                  f"{summary['kv_rebuilds']} rebuilds, "
+                  f"{summary['shed']} shed, "
+                  f"{summary['failed']} failed)")
     olog.event("loadtest", pattern=opts["pattern"],
                rate_qps=opts["rate_qps"], seed=opts["seed"], **point)
     log(f"loadtest: {shape} -> "
@@ -332,14 +400,71 @@ def _vs_baseline_artifact(sweep, path, log):
             "points": points}
 
 
-def _default_baseline() -> str:
-    """The committed single-pool artifact, resolved from the CWD first
-    (make runs at the repo root) then beside the package."""
-    if os.path.exists("SERVE_r01.json"):
-        return "SERVE_r01.json"
+def _vs_chaos_baseline(sweep, path, log):
+    """The bounded-degradation proof of a ``--chaos`` sweep against the
+    fault-free ``--disagg`` artifact (SERVE_r02.json): same seed, same
+    traffic, same carve, so at every shared device count the block pins
+    (1) the accounting invariant — ``completed + unserved + shed +
+    failed == offered``, every admitted request either finished, was
+    explicitly refused at the door, or explicitly failed its retry
+    budget; NOTHING silently lost — and (2) how far goodput/p99
+    degraded from the fault-free run.  Returns None (and logs) when the
+    baseline artifact is missing."""
+    if not path or not os.path.exists(path):
+        log(f"loadtest: chaos baseline artifact {path or '<unset>'} "
+            f"not found — vs_r02 omitted")
+        return None
+    with open(path) as f:
+        base = json.load(f)
+    by_dev = {int(p["devices"]): p for p in base.get("sweep", [])
+              if p.get("devices")}
+    points = {}
+    for p in sweep:
+        accounted = p["completed"] + p["unserved"] + p["shed"] \
+            + p["failed"]
+        entry = {
+            "offered": p["offered"],
+            "accounted": accounted,
+            "no_silent_loss": accounted == p["offered"],
+            "completed": p["completed"],
+            "unserved": p["unserved"],
+            "shed": p["shed"],
+            "failed": p["failed"],
+            "retries": p["retries"],
+            "kv_rebuilds": p["kv_rebuilds"],
+            "replica_downs": p["replica_downs"],
+        }
+        b = by_dev.get(int(p["devices"]))
+        if b is not None:
+            for k in ("completed", "goodput_qps", "p99_s",
+                      "ttft_p99_s"):
+                entry[f"{k}_r02"] = b.get(k)
+                entry[f"{k}_r03"] = _round(p.get(k))
+            if b.get("goodput_qps") and p.get("goodput_qps"):
+                entry["goodput_ratio"] = _round(
+                    p["goodput_qps"] / b["goodput_qps"], 4)
+            if b.get("p99_s") and p.get("p99_s"):
+                entry["p99_ratio"] = _round(p["p99_s"] / b["p99_s"], 4)
+        points[str(p["devices"])] = entry
+    return {"baseline": os.path.basename(path),
+            "baseline_schema": base.get("schema"),
+            "points": points}
+
+
+def _repo_artifact(name: str) -> str:
+    """A committed artifact, resolved from the CWD first (make runs at
+    the repo root) then beside the package."""
+    if os.path.exists(name):
+        return name
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    return os.path.join(root, "SERVE_r01.json")
+    return os.path.join(root, name)
+
+
+def _default_baseline() -> str:
+    """The committed single-pool artifact (fault-free disagg sweeps
+    compare against it)."""
+    return _repo_artifact("SERVE_r01.json")
 
 
 def run(opts, log=_err) -> dict:
@@ -368,7 +493,8 @@ def run(opts, log=_err) -> dict:
     base, top = sweep[0], sweep[-1]
     vs_baseline = (top["goodput_qps"] / base["goodput_qps"]) \
         if base["goodput_qps"] > 0 else None
-    kind = "disagg_serve" if opts["disagg"] else "serve"
+    kind = "chaos_serve" if opts["chaos"] \
+        else ("disagg_serve" if opts["disagg"] else "serve")
     line = {
         "metric": f"gpt_tiny_{kind}_qps_{top['devices']}dev",
         "value": _round(top["qps"], 4),
@@ -405,7 +531,17 @@ def run(opts, log=_err) -> dict:
                    ("metric", "value", "unit", "vs_baseline")},
         "sweep": [{k: _round(v) for k, v in p.items()} for p in sweep],
     }
-    if opts["disagg"]:
+    if opts["chaos"]:
+        artifact["disagg"] = True
+        artifact["chaos"] = opts["chaos"]
+        vs_r02 = _vs_chaos_baseline(
+            sweep, opts["baseline"] or _repo_artifact("SERVE_r02.json"),
+            log)
+        if vs_r02 is not None:
+            artifact["vs_r02"] = vs_r02
+            line["vs_r02"] = {d: e.get("goodput_ratio")
+                              for d, e in vs_r02["points"].items()}
+    elif opts["disagg"]:
         artifact["disagg"] = True
         vs_r01 = _vs_baseline_artifact(
             sweep, opts["baseline"] or _default_baseline(), log)

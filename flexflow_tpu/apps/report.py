@@ -321,11 +321,14 @@ def fusions_main(argv, log=print) -> int:
 
 def serve_main(argv, log=print) -> int:
     """The serving pass (``report serve``): render the latency histogram
-    + percentiles (latency, TTFT, TPOT), batch occupancy, and autoscale
-    resizes of a serving run's ``serve_*`` records (apps/serve.py
+    + percentiles (latency, TTFT, TPOT), batch occupancy, autoscale
+    resizes, and the resilience lines — per-crash ``replica_down``
+    summaries, retry/rebuild/fault counts, and SLO-burn shed totals —
+    of a serving run's ``serve_*`` records (apps/serve.py
     -obs-dir).  ``--trace OUT.trace.json`` exports the per-request
-    Perfetto lanes (+ fleet lanes when present), validated before
-    writing.  Exit 1 when the stream carries no serving records."""
+    Perfetto lanes (+ fault instant marks + fleet lanes when present),
+    validated before writing.  Exit 1 when the stream carries no
+    serving records."""
     from flexflow_tpu.obs.report import _serve_section, summarize
 
     json_out = "--json" in argv

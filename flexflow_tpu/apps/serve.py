@@ -66,6 +66,7 @@ def parse_args(argv):
         "step_time_s": 0.0, "tiny": False, "smoke": False,
         "prefill_devices": 0, "prefill_replicas": 1,
         "decode_replicas": 1, "disagg_smoke": False,
+        "chaos_smoke": False,
     }
     args = list(argv)
     if args and not args[0].startswith("-"):
@@ -117,6 +118,8 @@ def parse_args(argv):
             opts["decode_replicas"] = int(val())
         elif a == "--disagg-smoke":
             opts["disagg_smoke"] = True
+        elif a == "--chaos-smoke":
+            opts["chaos_smoke"] = True
     return opts
 
 
@@ -624,6 +627,180 @@ def _smoke_disagg(opts, log) -> dict:
     return summary
 
 
+#: the seeded chaos the recovery phase injects: the decode pool's
+#: third health-check probe kills a replica mid-decode (in-flight work
+#: re-prefills, queued handoffs retransmit), and the fifth KV transfer
+#: is dropped on the wire (retransmit) — both recover under the
+#: default retry budget with zero lost requests
+CHAOS_SMOKE_SPEC = "replica_crash@3,handoff_drop@5"
+
+
+def _smoke_chaos(opts, log) -> dict:
+    """The deterministic resilience scenario (make chaos-smoke), two
+    phases on the same pool shape (two 2-device prefill replicas + two
+    2-device decode replicas):
+
+    1. **equivalence** — the full resilience machinery ARMED (injector
+       installed with an empty spec, RetryPolicy, AdmissionGate) but
+       never firing must be byte-inert: replies and summary counters
+       bit-identical to a plain router on the same load, and to the
+       single-pool engine;
+    2. **recovery** — ``CHAOS_SMOKE_SPEC`` kills decode[0] at its third
+       health-check probe and drops the fifth KV handoff on the wire:
+       every admitted request still completes with BIT-IDENTICAL
+       replies (re-prefill regenerates the same greedy tokens), >= 1
+       kv_rebuild, exactly 1 replica_down, >= 2 serve_retry records,
+       zero unserved/failed/shed — bounded degradation, nothing
+       silently lost — and the obs stream renders + traces clean."""
+    from flexflow_tpu.machine import MachineModel
+    from flexflow_tpu.obs.trace import (chrome_trace, serve_trace_events,
+                                        validate_trace)
+    from flexflow_tpu.serve.engine import (DEFAULT_STEP_TIME_S,
+                                           ServeEngine)
+    from flexflow_tpu.serve.loadgen import patterned_requests
+    from flexflow_tpu.serve.router import AdmissionGate, ServeRouter
+    from flexflow_tpu.sim.search import decode_step_ratio
+    from flexflow_tpu.utils.faultinject import (FaultInjector,
+                                                install_scoped)
+    from flexflow_tpu.utils.retry import RetryPolicy
+    from flexflow_tpu import obs
+
+    machine = MachineModel()
+
+    def build_pools(olog, metrics):
+        prefill, decode = [], []
+        for j in range(2):
+            m = machine.shrink([2 * j, 2 * j + 1])
+            model, _ = _build_lm(m, batch=2, seed=0, tiny=True)
+            prefill.append(ServeEngine(
+                model, None, olog=olog, metrics=metrics,
+                log=lambda *a: None, step_time_s=DEFAULT_STEP_TIME_S,
+                phase="prefill"))
+        for j in range(2):
+            dm = machine.shrink([4 + 2 * j, 5 + 2 * j])
+            dmodel, _ = _build_lm(dm, batch=2, seed=0, tiny=True)
+            decode.append(ServeEngine(
+                dmodel, None, olog=olog, metrics=metrics,
+                log=lambda *a: None,
+                step_time_s=DEFAULT_STEP_TIME_S
+                * decode_step_ratio(dmodel),
+                phase="decode"))
+        return prefill, decode
+
+    def session_load():
+        return patterned_requests(12, seed=0, rate_qps=50.0,
+                                  pattern="session", vocab_size=64,
+                                  prompt_len=6, max_new_tokens=4)
+
+    def resilient_router(olog, metrics):
+        prefill, decode = build_pools(olog, metrics)
+        return ServeRouter(prefill, decode, olog=olog, metrics=metrics,
+                           log=log, retry_policy=RetryPolicy(),
+                           admission=AdmissionGate())
+
+    # ground truth: the single-pool engine's replies for the same load
+    single_model, _ = _build_lm(machine, batch=8, seed=0, tiny=True)
+    single = ServeEngine(single_model, None, log=lambda *a: None)
+    sreqs = session_load()
+    single.run(sreqs)
+    expected = {r.rid: list(r.reply) for r in sreqs}
+
+    # phase 1: armed machinery must be byte-inert.  Baseline = a plain
+    # router (no injector / retry / gate); armed = the full resilience
+    # stack with an EMPTY fault spec.
+    prefill0, decode0 = build_pools(obs.NULL, None)
+    plain = ServeRouter(prefill0, decode0, log=lambda *a: None)
+    breqs = session_load()
+    bsum = plain.run(breqs)
+    baseline = {r.rid: list(r.reply) for r in breqs}
+
+    olog, metrics = _olog_metrics(opts)
+    router = resilient_router(olog, metrics)
+    idle = FaultInjector("")  # armed-but-idle: enabled, never fires
+    restore = install_scoped(idle)
+    try:
+        areqs = session_load()
+        asum = router.run(areqs)
+    finally:
+        restore()
+    armed = {r.rid: list(r.reply) for r in areqs}
+    assert armed == baseline == expected, \
+        f"armed-but-idle resilience machinery must be byte-inert: " \
+        f"{armed} vs {baseline} vs {expected}"
+    assert idle.fired() == 0, \
+        f"an empty spec must never fire: {idle.fired()}"
+    assert asum["retries"] == asum["shed"] == asum["failed"] == 0 \
+        and asum["replica_down"] == 0 and asum["kv_rebuilds"] == 0, asum
+    inert_keys = ("completed", "unserved", "shed", "failed", "handoffs",
+                  "affinity_hits", "kv_refetches", "steps", "p50_s",
+                  "p99_s", "ttft_p50_s", "virtual_s")
+    diverged = {k: (bsum[k], asum[k]) for k in inert_keys
+                if bsum[k] != asum[k]}
+    assert not diverged, \
+        f"armed summary diverged from the plain router's: {diverged}"
+    log(f"chaos-smoke equivalence ok: armed-but-idle machinery "
+        f"byte-inert ({asum['completed']} replies bit-identical to "
+        f"plain router and single pool)")
+
+    # phase 2: the seeded chaos — recovery must be total
+    router2 = resilient_router(olog, metrics)
+    inj = FaultInjector(CHAOS_SMOKE_SPEC, olog=olog)
+    restore2 = install_scoped(inj)
+    try:
+        creqs = session_load()
+        csum = router2.run(creqs)
+    finally:
+        restore2()
+    chaos = {r.rid: list(r.reply) for r in creqs if r.reply is not None}
+    assert chaos == expected, \
+        f"recovered replies must be bit-identical to the fault-free " \
+        f"run: {chaos} vs {expected}"
+    assert csum["completed"] == 12 and csum["unserved"] == 0 \
+        and csum["failed"] == 0 and csum["shed"] == 0, csum
+    assert csum["completed"] + csum["unserved"] + csum["shed"] \
+        + csum["failed"] == csum["requests"] == 12, csum
+    assert csum["replica_down"] == 1, csum
+    assert csum["kv_rebuilds"] >= 1, \
+        f"the crash must force >= 1 KV re-materialization: {csum}"
+    assert csum["retries"] >= 2, csum
+    assert csum["replicas_live"] == 2, \
+        f"the crashed replica must be back by run end: {csum}"
+    assert inj.fired("replica_crash") == 1 \
+        and inj.fired("handoff_drop") == 1, \
+        f"spec {CHAOS_SMOKE_SPEC!r} must fire both faults: " \
+        f"{inj.fired('replica_crash')} crash(es), " \
+        f"{inj.fired('handoff_drop')} drop(s)"
+
+    if olog.enabled:
+        events = list(obs.read_run(olog.path))
+        downs = [e for e in events if e["kind"] == "replica_down"]
+        retries = [e for e in events if e["kind"] == "serve_retry"]
+        rebuilds = [e for e in events if e["kind"] == "kv_rebuild"]
+        assert len(downs) == 1 and downs[0]["replica"] == 0, downs
+        assert len(retries) == csum["retries"] and len(retries) >= 2, \
+            retries
+        assert len(rebuilds) == csum["kv_rebuilds"] >= 1, rebuilds
+        assert not any(e["kind"] == "serve_fault" for e in events)
+        errors = validate_trace(chrome_trace(serve_trace_events(events)))
+        assert not errors, errors
+        from flexflow_tpu.apps.report import serve_main
+
+        rendered = []
+        rc = serve_main([olog.path], log=lambda m: rendered.append(m))
+        assert rc == 0 and rendered, "report serve must render"
+        assert any("resilience:" in ln for ln in rendered), \
+            "report serve must render the resilience line"
+        for line in rendered:
+            log(line)
+    log(f"chaos-smoke recovery ok: {CHAOS_SMOKE_SPEC!r} -> "
+        f"{csum['completed']}/12 complete with bit-identical replies, "
+        f"{csum['replica_down']} replica down, {csum['kv_rebuilds']} "
+        f"KV rebuild(s), {csum['retries']} retry(ies), 0 lost")
+    csum["_olog"] = olog
+    olog.close()
+    return csum
+
+
 def _require_mesh() -> None:
     import jax
 
@@ -646,11 +823,17 @@ def disagg_smoke(opts, log=_err) -> dict:
     return _smoke_disagg(opts, log)
 
 
+def chaos_smoke(opts, log=_err) -> dict:
+    _require_mesh()
+    return _smoke_chaos(opts, log)
+
+
 def main(argv=None, log=_err) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     opts = parse_args(argv)
-    smoker = disagg_smoke if opts["disagg_smoke"] \
-        else (smoke if opts["smoke"] else None)
+    smoker = chaos_smoke if opts["chaos_smoke"] \
+        else (disagg_smoke if opts["disagg_smoke"]
+              else (smoke if opts["smoke"] else None))
     if smoker is not None and not opts["obs_dir"]:
         import tempfile
 

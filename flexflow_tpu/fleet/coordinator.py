@@ -375,6 +375,13 @@ class FleetCoordinator:
         self._update_metrics()
 
     def _maybe_rebalance(self) -> None:
+        """Re-pack and issue directed resizes when the demand key
+        shifts — a serve job crossing its queue watermark, a job
+        arriving/finishing, or a DEGRADED serve job
+        (:meth:`~flexflow_tpu.fleet.job.Job.mark_degraded`) raising
+        its bid to max after losing replicas: the emergency bid
+        changes ``_demands()`` and drives the fleet through the same
+        directed-resize path, and a successful resize clears it."""
         key = self._demands()
         if key == self._demand_key:
             return

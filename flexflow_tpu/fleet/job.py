@@ -201,6 +201,11 @@ class Job:
         #: steps actually executed in the most recent step_quantum call
         #: (the coordinator's per-round busy-device-steps accounting)
         self.last_quantum_steps = 0
+        #: decode replicas this serve job has currently lost (the
+        #: resilience round's degraded-capacity signal): while > 0 the
+        #: job bids ``max_devices`` so the coordinator re-prices the
+        #: fleet around the loss; a directed resize clears it
+        self.degraded = 0
         # sim mode: remaining virtual steps (0 for real jobs)
         self._sim_left = int(getattr(spec, "sim_steps", 0) or 0)
         if self._sim_left > 0 and spec.kind == "serve":
@@ -270,15 +275,45 @@ class Job:
     # ------------------------------------------------------------------
     # demand: what slice size the job currently bids for
 
+    def mark_degraded(self, lost: int,
+                      reason: str = "replica_crash") -> None:
+        """A serve job lost ``lost`` decode replica(s): record the
+        degraded capacity (one job-labeled ``replica_down`` event per
+        call) and raise the job's bid to ``max_devices`` so the next
+        ``_demands()`` key change drives the coordinator through a
+        directed re-price.  ``lost=0`` clears the flag explicitly (a
+        successful :meth:`resize` also clears it — restored capacity
+        ends the emergency bid)."""
+        if self.spec.kind != "serve":
+            raise JobStateError(
+                f"job {self.spec.job_id}: only serve jobs report "
+                f"degraded replica capacity")
+        self.degraded = max(0, int(lost))
+        if self.degraded:
+            detail = {}
+            if self.clock is not None:
+                detail["vts"] = self.clock.now()
+            self.olog.event(
+                "replica_down", job=self.spec.job_id, pool="serve",
+                replica=None, replicas_lost=self.degraded,
+                reason=reason, devices=len(self.ordinals), **detail)
+            self.log(f"fleet: job {self.spec.job_id} DEGRADED — "
+                     f"{self.degraded} replica(s) down ({reason}), "
+                     f"bidding max capacity for recovery")
+
     def demand(self, pool_size: int) -> int:
         """The size this job currently WANTS (the arbiter caps candidate
         slices at it): train jobs always bid their max (more devices is
         a faster step); serve jobs yield down to ``min_devices`` while
         the queue is calm and bid ``max_devices`` once depth crosses the
         ``queue_hi`` watermark — that demand shift is what triggers the
-        coordinator's rebalances."""
+        coordinator's rebalances.  A DEGRADED serve job (lost replicas,
+        :meth:`mark_degraded`) bids max regardless of its queue: it is
+        serving the same load on less hardware."""
         cap = self.spec.max_devices or pool_size
         if self.spec.kind == "train":
+            return min(cap, pool_size)
+        if self.spec.kind == "serve" and self.degraded > 0:
             return min(cap, pool_size)
         if (self.spec.queue_hi > 0 and self.engine is not None
                 and self.engine.queue_depth() >= self.spec.queue_hi):
@@ -525,6 +560,9 @@ class Job:
             # the regrid span (resized -> running gap)
             self.clock.advance(self.clock.resize_steps)
         self.to_state("running")
+        # a completed directed move restored the job's capacity — the
+        # degraded emergency bid (mark_degraded) ends here
+        self.degraded = 0
         return legs
 
     def _resize_leg(self, pool, target: List[int],

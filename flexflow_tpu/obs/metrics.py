@@ -94,6 +94,14 @@ _HELP = {
     "serve_pool_requests_total": "requests completed per pool, "
                                  "exported as ff_serve_pool_requests_"
                                  "total{pool=...}",
+    "serve_retries_total": "serving retries this run (handoff "
+                           "retransmits + KV rebuilds under the "
+                           "router's RetryPolicy)",
+    "serve_shed_total": "serving arrivals shed by the SLO-burn "
+                        "admission gate this run (explicit "
+                        "serve_shed records, never silent drops)",
+    "replicas_live": "decode replicas currently live (crashed "
+                     "replicas leave until their restart_s revival)",
     "slo_burn_rate": "SLO error-budget burn rate over the full stream "
                      "(1.0 = burning exactly the budget)",
     "slo_max_window_burn_rate": "worst rolling-window SLO burn rate",
@@ -117,7 +125,8 @@ _HELP = {
 _COUNTER_EXTRA = {"fleet_rebalances_total"}
 _COUNTERS = {"steps_total", "rollbacks_total", "faults_total",
              "prefetch_stall_seconds_total", "elastic_events",
-             "requests_total"} | _COUNTER_EXTRA
+             "requests_total", "serve_retries_total",
+             "serve_shed_total"} | _COUNTER_EXTRA
 
 # Fixed log-spaced latency buckets: 1 ms .. 100 s in quarter-decade
 # steps (21 finite upper bounds + the implicit +Inf).  Fixed — never

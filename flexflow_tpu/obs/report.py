@@ -465,8 +465,14 @@ def _serve_section(events: List[Dict]) -> List[str]:
     handoffs = [e for e in events if e.get("kind") == "serve_handoff"]
     refetches = [e for e in events if e.get("kind") == "kv_refetch"]
     routers = [e for e in events if e.get("kind") == "router_summary"]
+    retries = [e for e in events if e.get("kind") == "serve_retry"]
+    faults = [e for e in events if e.get("kind") == "serve_fault"]
+    rebuilds = [e for e in events if e.get("kind") == "kv_rebuild"]
+    sheds = [e for e in events if e.get("kind") == "serve_shed"]
+    downs = [e for e in events if e.get("kind") == "replica_down"]
     if not (reqs or batches or resizes or summaries or handoffs
-            or refetches or routers):
+            or refetches or routers or retries or faults or rebuilds
+            or sheds or downs):
         return []
     lines = ["== serving =="]
     lat = sorted(float(e["latency_s"]) for e in reqs
@@ -528,17 +534,52 @@ def _serve_section(events: List[Dict]) -> List[str]:
             f"{len(refetches)} kv_refetch(es)")
     elif refetches:
         lines.append(f"  kv_refetches: {len(refetches)}")
+    for d in downs:
+        lines.append(
+            f"  replica_down[{d.get('pool', '?')}"
+            f"[{d.get('replica', '?')}]] at v="
+            f"{_fmt_s(d.get('vnow') or 0.0)}: "
+            f"{d.get('in_flight', 0)} in-flight re-prefill, "
+            f"{d.get('queued', 0)} queued retransmit, restart "
+            f"{_fmt_s(d.get('restart_s') or 0.0)}")
+    if retries or rebuilds or faults:
+        by_reason: Dict[str, int] = {}
+        for r in retries:
+            reason = str(r.get("reason", "?"))
+            by_reason[reason] = by_reason.get(reason, 0) + 1
+        reason_part = ", ".join(f"{k} x{v}"
+                                for k, v in sorted(by_reason.items()))
+        lines.append(
+            f"  resilience: {len(retries)} serve_retry "
+            f"({reason_part or 'none'}), {len(rebuilds)} kv_rebuild "
+            f"(re-prefilled sessions), {len(faults)} serve_fault "
+            f"(retry budget exhausted)")
+    if sheds:
+        burns = [float(s.get("burn_rate", 0.0)) for s in sheds]
+        lines.append(
+            f"  shed: {len(sheds)} arrival(s) refused by the SLO-burn "
+            f"admission gate (burn {min(burns):.2f}x..{max(burns):.2f}x"
+            f" over threshold) — explicit serve_shed, not drops")
     for r in routers:
         pools = r.get("pools") or {}
         pool_part = ", ".join(
             f"{k}: {v.get('replicas', '?')}x{v.get('devices', 0) // max(v.get('replicas', 1), 1)}dev"
             for k, v in sorted(pools.items()))
+        resil_part = ""
+        if any(r.get(k) for k in ("retries", "kv_rebuilds",
+                                  "replica_down", "shed", "failed")):
+            resil_part = (
+                f", {r.get('replica_down', 0)} replica(s) down, "
+                f"{r.get('retries', 0)} retry(ies), "
+                f"{r.get('kv_rebuilds', 0)} rebuild(s), "
+                f"{r.get('shed', 0)} shed, "
+                f"{r.get('failed', 0)} failed")
         lines.append(
             f"  router: {r.get('completed', 0)}/{r.get('requests', 0)} "
             f"served across {pool_part or '?'}, "
             f"{r.get('handoffs', 0)} handoff(s), "
             f"{r.get('affinity_hits', 0)} affinity hit(s), "
-            f"{r.get('kv_refetches', 0)} refetch(es)"
+            f"{r.get('kv_refetches', 0)} refetch(es)" + resil_part
             + (", drained" if r.get("drained") else ""))
     for r in resizes:
         research = r.get("research") or {}
@@ -774,7 +815,8 @@ def _misc_section(events: List[Dict]) -> List[str]:
              "ckpt_async", "lint",
              "serve_request", "serve_batch", "serve_resize",
              "serve_summary", "serve_handoff", "kv_refetch",
-             "router_summary",
+             "router_summary", "serve_fault", "serve_retry",
+             "kv_rebuild", "serve_shed", "replica_down",
              "fleet_job", "fleet_placement", "fleet_rebalance",
              "fleet_summary", "fleet_wait", "fleet_util", "fleetsim"}
     lines = []
@@ -1056,7 +1098,8 @@ def summarize(events: Iterable[Dict]) -> Dict:
         out["elastic"] = el
     serve_kinds = ("serve_request", "serve_batch", "serve_resize",
                    "serve_summary", "serve_handoff", "kv_refetch",
-                   "router_summary")
+                   "router_summary", "serve_fault", "serve_retry",
+                   "kv_rebuild", "serve_shed", "replica_down")
     if any(kinds.get(k) for k in serve_kinds):
         sv: Dict = {"counts": {k: kinds[k] for k in serve_kinds
                                if kinds.get(k)}}
@@ -1114,7 +1157,18 @@ def summarize(events: Iterable[Dict]) -> Dict:
                              "ttft_p99_s", "tpot_p50_s", "steps",
                              "devices", "pools", "handoffs",
                              "affinity_hits", "kv_refetches",
-                             "drained")}
+                             "drained", "shed", "failed", "retries",
+                             "kv_rebuilds", "replica_down",
+                             "replicas_live", "recovery")}
+        if any(kinds.get(k) for k in ("serve_retry", "serve_fault",
+                                      "kv_rebuild", "serve_shed",
+                                      "replica_down")):
+            sv["resilience"] = {
+                "retries": kinds.get("serve_retry", 0),
+                "faults": kinds.get("serve_fault", 0),
+                "kv_rebuilds": kinds.get("kv_rebuild", 0),
+                "sheds": kinds.get("serve_shed", 0),
+                "replica_downs": kinds.get("replica_down", 0)}
         out["serve"] = sv
     slos = [e for e in events if e.get("kind") == "slo"]
     if slos:

@@ -26,6 +26,14 @@ in-memory list) and never touches jax.
 (:func:`log_record`), ``ff_slo_*`` gauges on a live
 :class:`~flexflow_tpu.obs.metrics.MetricsExporter`
 (:func:`export_gauges`), and the ``report slo`` CLI.
+
+The serving router's admission gate
+(:class:`~flexflow_tpu.serve.router.AdmissionGate`) reuses this
+module's burn definition (:func:`_burn`) live at each event-loop
+boundary — completions inside the gate's ``window_s`` price the
+rolling burn, and while it exceeds the gate's threshold new arrivals
+shed through a token bucket (explicit ``serve_shed`` records), so the
+same number that drives alerting drives load shedding.
 """
 
 from __future__ import annotations

@@ -314,7 +314,14 @@ def serve_trace_events(records: Iterable[Dict], pid: int = PID_SERVE,
         admitted slots, and KV-cache occupancy (tokens + fraction of
         the ``max_batch x max_seq`` rectangle) over virtual time —
         per pool (``... [prefill]``/``... [decode]``) when the batch
-        records carry pool labels.
+        records carry pool labels;
+      * resilience instants (``ph: "i"``, cat ``fault`` — never
+        ``compute``, so the overlap check ignores them): per-request
+        marks on the rid's lane for ``serve_retry`` / ``serve_fault``
+        / ``kv_rebuild`` / ``serve_shed`` records (shed rids get a
+        lane even though they never produce a ``serve_request``), and
+        process-scoped ``replica_down`` marks on a dedicated
+        ``replica faults`` lane.
 
     Timestamps are shifted so the earliest arrival lands at 0 (trace
     viewers and :func:`validate_trace` want non-negative ts)."""
@@ -323,13 +330,19 @@ def serve_trace_events(records: Iterable[Dict], pid: int = PID_SERVE,
     batches = [r for r in records if r.get("kind") == "serve_batch"]
     handoffs = {r.get("rid"): r for r in records
                 if r.get("kind") == "serve_handoff"}
+    marks = [r for r in records
+             if r.get("kind") in ("serve_retry", "serve_fault",
+                                  "kv_rebuild", "serve_shed")]
+    downs = [r for r in records if r.get("kind") == "replica_down"]
     events = [meta_event(pid, label)]
-    if not reqs and not batches:
+    if not reqs and not batches and not marks and not downs:
         return events
     t0 = min([float(r["arrival_v"]) for r in reqs
               if r.get("arrival_v") is not None]
              + [float(b["vnow"]) for b in batches
-                if b.get("vnow") is not None] + [0.0])
+                if b.get("vnow") is not None]
+             + [float(m["vnow"]) for m in marks + downs
+                if m.get("vnow") is not None] + [0.0])
 
     def ts(v: float) -> float:
         return (float(v) - t0) * _US
@@ -398,6 +411,37 @@ def serve_trace_events(records: Iterable[Dict], pid: int = PID_SERVE,
                 "ts": ts(admit),
                 "dur": max(0.0, (float(done) - float(admit)) * _US),
                 "pid": pid, "tid": tid, "args": decode_args})
+    # resilience marks: per-request fault/retry/rebuild/shed instants
+    # on the rid's lane (allocated on demand — a shed request has no
+    # serve_request record, but its refusal still deserves a mark)
+    for m in marks:
+        rid, vnow = m.get("rid"), m.get("vnow")
+        if vnow is None:
+            continue
+        if rid not in tids:
+            tids[rid] = 10 + len(tids)
+            events.append(meta_event(pid, f"req {rid}", tids[rid]))
+        args = {k: m.get(k) for k in
+                ("rid", "reason", "attempt", "attempts", "delay_s",
+                 "tokens", "to_replica", "burn_rate", "priority")
+                if m.get(k) is not None}
+        events.append({"name": m["kind"], "cat": "fault", "ph": "i",
+                       "s": "t", "ts": ts(vnow), "pid": pid,
+                       "tid": tids[rid], "args": args})
+    # pool-level replica_down instants on a dedicated faults lane
+    if downs:
+        events.append(meta_event(pid, "replica faults", 9))
+    for d in downs:
+        vnow = d.get("vnow")
+        if vnow is None:
+            continue
+        events.append({
+            "name": f"replica_down {d.get('pool')}[{d.get('replica')}]",
+            "cat": "fault", "ph": "i", "s": "p", "ts": ts(vnow),
+            "pid": pid, "tid": 9,
+            "args": {k: d.get(k) for k in
+                     ("pool", "replica", "in_flight", "queued",
+                      "restart_s") if d.get(k) is not None}})
     # admission groups -> flow arrows between member lanes
     groups: Dict[float, List[Dict]] = {}
     for r in reqs:

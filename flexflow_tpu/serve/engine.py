@@ -66,10 +66,15 @@ from flexflow_tpu.serve.batcher import (ContinuousBatcher, RequestQueue,
                                         batch_requests)
 from flexflow_tpu.serve.kv_cache import KVCache, KVCacheLayout
 from flexflow_tpu.serve.loadgen import Request
+from flexflow_tpu.utils import faultinject
 
 # default virtual service time per decode step / forward batch, used
 # when the strategy artifact carries no predicted forward time
 DEFAULT_STEP_TIME_S = 0.01
+
+# virtual slowdown an injected ``slow_replica`` fault applies to one
+# decode step (a straggler, not a death — the hedged-decode adversary)
+SLOW_REPLICA_FACTOR = 4.0
 
 
 def _percentile(values: Sequence[float], q: float) -> float:
@@ -243,6 +248,45 @@ class ServeEngine:
         if s is not None and v > s["vnow"]:
             s["vnow"] = float(v)
 
+    def session_vnow(self) -> Optional[float]:
+        """The open session's virtual now (None when none is open) —
+        the router's dispatch timestamp for this engine's handoffs."""
+        s = self._sess
+        return float(s["vnow"]) if s is not None else None
+
+    def crash(self) -> Dict:
+        """Kill the open session in place — the injected
+        ``replica_crash`` path (serve/router.py).  Everything resident
+        dies with the replica: in-flight slots lose their imported KV
+        rows (their requests leave carrying every token generated so
+        far, ready for the router's re-prefill ``kv_rebuild``), queued
+        handoffs are returned with payloads intact (retransmittable,
+        the bytes never left the host), and the pre-crash
+        completion/step counts are handed to the router — a revived
+        engine's :meth:`finish` only covers its NEW session.  Revival
+        is a fresh :meth:`start`."""
+        s = self._sess
+        if s is None:
+            raise RuntimeError("serve: no open session to crash")
+        batcher = s["batcher"]
+        in_flight: List[Request] = []
+        for slot_idx, slot in list(batcher.active()):
+            req = slot.req
+            req.carried_tokens = slot.tokens[len(req.tokens):]
+            req.kv_payload = None  # the imported rows died with the mesh
+            batcher.release(slot_idx)
+            in_flight.append(req)
+        queued = s["queue"].drain()
+        out = {"in_flight": in_flight, "queued": queued,
+               "completed": list(s["completed"]),
+               "steps": int(s["steps"]), "vnow": float(s["vnow"])}
+        if self.kv_cache is not None:
+            for i in range(self.max_batch):
+                self.kv_cache.reclaim(i)
+        self._kv_filled = [0] * self.max_batch
+        self._sess = None
+        return out
+
     def next_ready_v(self) -> Optional[float]:
         """The earliest virtual instant this session can do work: its
         current vnow while slots are in flight, the next queued
@@ -385,7 +429,16 @@ class ServeEngine:
         logprobs = np.asarray(outs[0])
         step_wall = time.perf_counter() - t0
         self._fill_kv(outs[1:], active, pre_lengths)
-        done_v = vnow + self.step_time_s  # this step's tokens land here
+        step_s = self.step_time_s
+        if self.phase == "decode":
+            # injected straggler: this step's virtual service time
+            # stretches, delaying every token it lands — the p99 tail
+            # the hedged-decode mode protects against.  Host-side only:
+            # with no injector armed the branch is byte-inert.
+            inj = faultinject.get()
+            if inj.enabled and inj.fire("slow_replica", site=self.pool):
+                step_s *= SLOW_REPLICA_FACTOR
+        done_v = vnow + step_s  # this step's tokens land here
         for slot_idx, slot in active:
             nxt_tok = int(np.argmax(logprobs[slot_idx,
                                              slot.length - 1]))

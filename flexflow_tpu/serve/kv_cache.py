@@ -25,6 +25,16 @@ is FILLED from that same forward (K/V projected with the op's own
 weights, exact by construction, pinned by tests) and carries the layout
 + byte accounting the incremental TPU decode kernel targets.  What would
 change on TPU is the consumer, not this module.
+
+Resilience contract (serve/router.py leans on these properties): an
+``export_request`` payload is plain host-side numpy, so a
+``handoff_drop`` fault loses only the in-flight transfer — the payload
+survives for retransmit; IMPORTED rows live in the destination
+replica's cache and die with it on ``replica_crash``, which is why the
+router re-materializes a crashed session by re-prefilling its carried
+tokens (``kv_rebuild``) instead of re-importing; a ``kv_corrupt``
+payload is discarded wholesale (rows are untrusted) and takes the same
+rebuild path.
 """
 
 from __future__ import annotations

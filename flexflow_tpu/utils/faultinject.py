@@ -63,7 +63,30 @@ Kinds:
                         watchdog's deadline (``--hang-factor``,
                         utils/health.StepWatchdog), converting a wedged
                         collective into the probe/classify recovery
-                        path.
+                        path;
+  * ``replica_crash`` — serving (serve/router.py): counted per
+                        decode-boundary HEALTH CHECK per live decode
+                        replica (the router probes replicas in index
+                        order at each boundary it steps); on fire the
+                        probed replica dies — its in-flight sessions
+                        lose their imported KV and re-route through the
+                        ``kv_rebuild`` re-prefill path, its queued
+                        handoffs retransmit, and the replica revives
+                        after the router's ``restart_s``;
+  * ``handoff_drop``  — counted per DISPATCHED prefill->decode handoff:
+                        the priced transfer is lost in flight (the
+                        payload survives host-side), so the request
+                        retries the retransmit path under the router's
+                        RetryPolicy;
+  * ``kv_corrupt``    — counted per dispatched handoff alongside
+                        ``handoff_drop``: the payload arrives but its
+                        rows are untrusted — the router discards it and
+                        re-materializes the session by re-prefilling
+                        its carried tokens (``kv_rebuild``);
+  * ``slow_replica``  — counted per DECODE-phase engine step: that step
+                        takes ``SLOW_REPLICA_FACTOR`` times its virtual
+                        service time (a straggler, not a death) —
+                        the hedged-decode mode's p99 adversary.
 
 One injector is installed process-globally (``install``/``get``) so data
 sources running on background threads see the same schedule; ``fit()``
@@ -79,7 +102,8 @@ from typing import Dict, List, Optional, Tuple
 
 KINDS = ("loss_nan", "data_io", "ckpt_truncate", "ckpt_corrupt",
          "device_loss", "host_crash", "device_return", "preempt",
-         "step_hang")
+         "step_hang", "replica_crash", "handoff_drop", "kv_corrupt",
+         "slow_replica")
 
 
 class FaultSpecError(ValueError):

@@ -1,0 +1,688 @@
+"""Resilient serving under deterministic chaos: the four injector
+kinds (``replica_crash`` / ``handoff_drop`` / ``kv_corrupt`` /
+``slow_replica``) through spec parsing, the router's crash/re-route
+recovery (bit-identical replies via KV re-materialization), bounded
+retry exhaustion (explicit ``serve_fault``), SLO-burn admission
+shedding (explicit ``serve_shed`` — shed != dropped), hedged-decode
+first-wins, the fleet degraded-capacity bid (``Job.mark_degraded``),
+the drain-during-handoff regression (pending retries become EXPLICIT
+unserved), the committed SERVE_r03.json bounded-degradation artifact,
+and the ``serve_retry`` / ``kv_rebuild`` / ``replica_down`` obs
+records through report, summarize, trace marks and metrics gauges."""
+
+import json
+import math
+import os
+
+import pytest
+
+from flexflow_tpu.serve.loadgen import Request, patterned_requests
+from flexflow_tpu.utils import faultinject
+from flexflow_tpu.utils.retry import RetryPolicy
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _session_load():
+    return patterned_requests(12, seed=0, rate_qps=50.0,
+                              pattern="session", vocab_size=64,
+                              prompt_len=6, max_new_tokens=4)
+
+
+def _req(rid, *, arrival_v=0.0, priority=0, session=None):
+    import numpy as np
+
+    r = Request(rid=rid, arrival_v=arrival_v,
+                tokens=np.array([2, 3, 4]), max_new_tokens=2)
+    r.priority = priority
+    r.session = session
+    return r
+
+
+# ---------------------------------------------------------------------------
+# fixtures: shared read-only models, fresh engines per test
+
+
+@pytest.fixture(scope="module")
+def resil_models(machine8):
+    """2x2-device prefill + 2x2-device decode models (the chaos-smoke
+    geometry).  Models are read-only across engines — each test builds
+    FRESH ServeEngines (per-engine KV/session state) on top."""
+    from flexflow_tpu.apps.serve import _build_lm
+
+    pmodels, dmodels = [], []
+    for j in range(2):
+        m = machine8.shrink([2 * j, 2 * j + 1])
+        model, _ = _build_lm(m, batch=2, seed=0, tiny=True)
+        pmodels.append(model)
+    for j in range(2):
+        m = machine8.shrink([4 + 2 * j, 5 + 2 * j])
+        model, _ = _build_lm(m, batch=2, seed=0, tiny=True)
+        dmodels.append(model)
+    return pmodels, dmodels
+
+
+def _fresh_engines(resil_models):
+    from flexflow_tpu.serve.engine import (DEFAULT_STEP_TIME_S,
+                                           ServeEngine)
+    from flexflow_tpu.sim.search import decode_step_ratio
+
+    pmodels, dmodels = resil_models
+    prefill = [ServeEngine(m, None, log=lambda *a: None,
+                           step_time_s=DEFAULT_STEP_TIME_S,
+                           phase="prefill") for m in pmodels]
+    decode = [ServeEngine(
+        m, None, log=lambda *a: None,
+        step_time_s=DEFAULT_STEP_TIME_S * decode_step_ratio(m),
+        phase="decode") for m in dmodels]
+    return prefill, decode
+
+
+def _run_router(resil_models, spec=None, *, olog=None, drain=None,
+                reqs=None, **router_kw):
+    """One routed run under an optionally-installed injector; returns
+    (requests, summary, injector, router)."""
+    from flexflow_tpu.serve.router import ServeRouter
+
+    prefill, decode = _fresh_engines(resil_models)
+    router = ServeRouter(prefill, decode, log=lambda *a: None,
+                         olog=olog, **router_kw)
+    inj = None
+    restore = lambda: None  # noqa: E731
+    if spec is not None:
+        inj = faultinject.FaultInjector(spec, olog=olog)
+        restore = faultinject.install_scoped(inj)
+    try:
+        reqs = _session_load() if reqs is None else reqs
+        summary = router.run(reqs, drain=drain)
+    finally:
+        restore()
+    return reqs, summary, inj, router
+
+
+@pytest.fixture(scope="module")
+def routed_baseline(resil_models):
+    """The no-fault routed run every recovery path must reproduce
+    bit-identically (test_disagg pins this equals the single pool)."""
+    reqs, summary, _, _ = _run_router(resil_models)
+    return {r.rid: list(r.reply) for r in reqs}, summary
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+
+
+class TestChaosSpec:
+    def test_new_kinds_registered_and_parse(self):
+        for kind in ("replica_crash", "handoff_drop", "kv_corrupt",
+                     "slow_replica"):
+            assert kind in faultinject.KINDS
+        parsed = faultinject.parse_fault_spec(
+            "replica_crash@3,handoff_drop@5x2,kv_corrupt@7,"
+            "slow_replica@2")
+        assert parsed["replica_crash"] == [(3, 1)]
+        assert parsed["handoff_drop"] == [(5, 2)]
+        assert parsed["kv_corrupt"] == [(7, 1)]
+        assert parsed["slow_replica"] == [(2, 1)]
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(faultinject.FaultSpecError,
+                           match="unknown fault kind"):
+            faultinject.parse_fault_spec("replica_hang@3")
+
+    def test_armed_idle_router_is_inert(self, resil_models,
+                                        routed_baseline):
+        """An installed-but-empty injector plus the full resilience
+        machinery must not change a single reply or counter."""
+        expected, base = routed_baseline
+        reqs, summary, inj, _ = _run_router(
+            resil_models, "", retry_policy=RetryPolicy())
+        assert {r.rid: list(r.reply) for r in reqs} == expected
+        assert inj.fired() == 0
+        for k in ("completed", "unserved", "shed", "failed",
+                  "handoffs", "affinity_hits", "kv_refetches",
+                  "retries", "kv_rebuilds", "replica_down", "steps",
+                  "p50_s", "p99_s", "ttft_p50_s", "virtual_s"):
+            assert summary[k] == base[k], k
+
+
+# ---------------------------------------------------------------------------
+# crash recovery: bit-identical replies through every fault path
+
+
+class TestCrashRecovery:
+    def test_replica_crash_reroutes_bit_identical(self, resil_models,
+                                                  routed_baseline):
+        """The tentpole invariant: a decode replica dying mid-run
+        changes WHERE the tail decodes, never WHAT decodes — in-flight
+        sessions re-prefill their carried prefix, queued handoffs
+        retransmit, and every reply matches the undisturbed run."""
+        expected, _ = routed_baseline
+        olog_reqs, summary, inj, _ = _run_router(
+            resil_models, "replica_crash@3",
+            retry_policy=RetryPolicy())
+        assert {r.rid: list(r.reply) for r in olog_reqs} == expected
+        assert inj.fired("replica_crash") == 1
+        assert summary["replica_down"] == 1
+        assert summary["completed"] == 12
+        assert summary["unserved"] == 0
+        assert summary["failed"] == 0 and summary["shed"] == 0
+        assert summary["requests"] == 12
+        # the crashed replica revived — full capacity at exit
+        assert summary["replicas_live"] == 2
+        # recovery percentiles cover the crash's victims
+        rec = summary["recovery"].get("replica_crash")
+        if summary["retries"]:
+            assert rec is not None and rec["n"] >= 1
+            assert rec["p50_s"] > 0 and rec["p99_s"] >= rec["p50_s"]
+
+    def test_crash_recovery_deterministic(self, resil_models):
+        """Same seeded load + same fault spec => bit-equal timeline."""
+        a_reqs, a, _, _ = _run_router(resil_models, "replica_crash@3")
+        b_reqs, b, _, _ = _run_router(resil_models, "replica_crash@3")
+        assert {r.rid: list(r.reply) for r in a_reqs} \
+            == {r.rid: list(r.reply) for r in b_reqs}
+        for k in ("completed", "retries", "kv_rebuilds",
+                  "replica_down", "p99_s", "virtual_s", "steps"):
+            assert a[k] == b[k], k
+
+    def test_kv_corrupt_rebuilds(self, resil_models, routed_baseline):
+        """An untrusted payload is discarded and the session
+        re-materialized by re-prefilling — a priced kv_rebuild, and
+        greedy argmax makes the regenerated tail identical."""
+        expected, _ = routed_baseline
+        reqs, summary, inj, _ = _run_router(resil_models,
+                                            "kv_corrupt@2")
+        assert {r.rid: list(r.reply) for r in reqs} == expected
+        assert inj.fired("kv_corrupt") == 1
+        assert summary["kv_rebuilds"] >= 1
+        assert summary["retries"] >= 1
+        assert summary["completed"] == 12 and summary["failed"] == 0
+
+    def test_handoff_drop_retransmits(self, resil_models,
+                                      routed_baseline):
+        expected, _ = routed_baseline
+        reqs, summary, inj, _ = _run_router(resil_models,
+                                            "handoff_drop@2")
+        assert {r.rid: list(r.reply) for r in reqs} == expected
+        assert inj.fired("handoff_drop") == 1
+        assert summary["retries"] >= 1
+        assert summary["kv_rebuilds"] == 0  # payload survived host-side
+        assert summary["completed"] == 12 and summary["failed"] == 0
+
+    def test_all_decode_down_parks_until_revival(self, resil_models,
+                                                 routed_baseline):
+        """Both decode replicas dead at one boundary: handoffs PARK
+        (no retry burned) until the earliest revival, then everything
+        completes — the loop never exits over parked work."""
+        expected, _ = routed_baseline
+        reqs, summary, inj, _ = _run_router(resil_models,
+                                            "replica_crash@1x2")
+        assert inj.fired("replica_crash") == 2
+        assert summary["replica_down"] == 2
+        assert {r.rid: list(r.reply) for r in reqs} == expected
+        assert summary["completed"] == 12
+        assert summary["unserved"] == 0 and summary["failed"] == 0
+        assert summary["replicas_live"] == 2
+
+    def test_slow_replica_stretches_time_not_tokens(self, resil_models,
+                                                    routed_baseline):
+        """A straggler is a latency fault, not a correctness fault:
+        the stretched steps move virtual time, never the argmax."""
+        expected, base = routed_baseline
+        reqs, summary, inj, _ = _run_router(resil_models,
+                                            "slow_replica@1x4")
+        assert inj.fired("slow_replica") == 4
+        assert {r.rid: list(r.reply) for r in reqs} == expected
+        assert summary["completed"] == 12
+        assert summary["p99_s"] > base["p99_s"]
+
+
+# ---------------------------------------------------------------------------
+# retry exhaustion -> explicit failure
+
+
+class TestRetryExhaustion:
+    def test_budget_exhaustion_is_explicit(self, resil_models,
+                                           tmp_path):
+        """A permanent fault (every dispatch drops) burns the bounded
+        retry budget and lands as serve_fault records — never a
+        silently missing request."""
+        from flexflow_tpu import obs
+
+        olog = obs.RunLog(str(tmp_path / "r.jsonl"), surface="serve")
+        reqs, summary, inj, _ = _run_router(
+            resil_models, "handoff_drop@1x99", olog=olog,
+            retry_policy=RetryPolicy(attempts=2, base_delay=0.001,
+                                     jitter=0.0))
+        olog.close()
+        assert summary["failed"] >= 1
+        assert summary["completed"] + summary["unserved"] \
+            + summary["shed"] + summary["failed"] == 12
+        assert summary["requests"] == 12
+        events = list(obs.read_run(olog.path))
+        faults = [e for e in events if e.get("kind") == "serve_fault"]
+        assert len(faults) == summary["failed"]
+        for f in faults:
+            assert f["reason"] == "handoff_drop"
+            assert f["attempts"] == 2
+        retries = [e for e in events if e.get("kind") == "serve_retry"]
+        assert len(retries) == summary["retries"] >= 1
+        # a failed request has no reply — and is never in completed
+        failed_rids = {f["rid"] for f in faults}
+        for r in reqs:
+            if r.rid in failed_rids:
+                assert r.reply is None
+
+
+# ---------------------------------------------------------------------------
+# SLO-burn admission shedding
+
+
+class TestShedding:
+    def test_forced_burn_sheds_explicitly(self, resil_models,
+                                          tmp_path):
+        """An impossible latency target + an empty token bucket: every
+        arrival after the first completion is refused at the door with
+        a serve_shed record, and the accounting closes exactly."""
+        from flexflow_tpu import obs
+        from flexflow_tpu.serve.router import AdmissionGate
+
+        olog = obs.RunLog(str(tmp_path / "s.jsonl"), surface="serve")
+        reqs, summary, _, _ = _run_router(
+            resil_models, olog=olog,
+            admission=AdmissionGate(latency_target_s=1e-6,
+                                    window_s=100.0, bucket_rate=0.0,
+                                    bucket_cap=0.0))
+        olog.close()
+        assert summary["shed"] >= 1
+        assert summary["completed"] >= 1
+        assert summary["completed"] + summary["unserved"] \
+            + summary["shed"] + summary["failed"] == 12
+        events = list(obs.read_run(olog.path))
+        sheds = [e for e in events if e.get("kind") == "serve_shed"]
+        assert len(sheds) == summary["shed"]
+        shed_rids = {s["rid"] for s in sheds}
+        for r in reqs:
+            if r.rid in shed_rids:
+                assert r.reply is None
+        for s in sheds:
+            assert s["burn_rate"] > 1.0
+
+    def test_lowest_priority_sheds_first(self, resil_models):
+        """At one gated boundary with one bucket token, the highest-
+        priority arrival admits and the rest shed, lowest first."""
+        from flexflow_tpu.serve.router import AdmissionGate, ServeRouter
+
+        prefill, decode = _fresh_engines(resil_models)
+        router = ServeRouter(
+            prefill, decode, log=lambda *a: None,
+            admission=AdmissionGate(bucket_rate=0.0, bucket_cap=1.0))
+        router._burn_rate = lambda t: 99.0
+        for eng in prefill:
+            eng.start([], open_ended=True)
+        lo, hi, mid = _req(1, priority=0), _req(2, priority=2), \
+            _req(3, priority=1)
+        router._admit_arrivals([lo, hi, mid], 0.0)
+        assert router.sheds == 2
+        # admission order was (-priority, ...): hi spent the one token
+        assert [r.rid for r in router._shed] == [mid.rid, lo.rid]
+        assert sum(eng.load() for eng in prefill) == 1
+
+
+# ---------------------------------------------------------------------------
+# hedged decode
+
+
+class TestHedging:
+    def test_hedged_run_bit_identical_and_deterministic(
+            self, resil_models, routed_baseline):
+        """Racing clones against a slow_replica straggler changes
+        timing only: replies stay bit-identical, clone records never
+        leak into the completion set, and the run repeats bit-equal."""
+        expected, _ = routed_baseline
+        a_reqs, a, _, _ = _run_router(resil_models, "slow_replica@1x6",
+                                      hedge=True)
+        assert {r.rid: list(r.reply) for r in a_reqs} == expected
+        assert a["hedges"] >= 1
+        assert a["completed"] == 12
+        assert a["hedge_wins"] >= 0
+        b_reqs, b, _, _ = _run_router(resil_models, "slow_replica@1x6",
+                                      hedge=True)
+        for k in ("hedges", "hedge_wins", "completed", "p99_s",
+                  "virtual_s"):
+            assert a[k] == b[k], k
+
+    def test_resolve_hedges_first_wins(self, resil_models):
+        from flexflow_tpu.serve.router import (HEDGE_RID_BASE,
+                                               ServeRouter)
+
+        prefill, decode = _fresh_engines(resil_models)
+        router = ServeRouter(prefill, decode, log=lambda *a: None)
+        router.hedges = 3
+
+        def done(rid, done_v, reply):
+            r = _req(rid)
+            r.done_v = done_v
+            r.reply = reply
+            return r
+
+        win_prim = done(1, 5.0, [7, 7])
+        win_clone = done(1 + HEDGE_RID_BASE, 3.0, [7, 7])
+        tie_prim = done(2, 4.0, [8])
+        tie_clone = done(2 + HEDGE_RID_BASE, 4.0, [9])
+        orphan = done(3 + HEDGE_RID_BASE, 1.0, [5])
+        out = router._resolve_hedges(
+            [win_prim, win_clone, tie_prim, tie_clone, orphan])
+        # clones and orphans never survive into the completion set
+        assert [r.rid for r in out] == [1, 2]
+        # the strictly-earlier clone donated its stamps to the primary
+        assert win_prim.done_v == 3.0
+        assert router.hedge_wins == 1
+        # ties keep the primary's result
+        assert tie_prim.done_v == 4.0 and tie_prim.reply == [8]
+
+
+# ---------------------------------------------------------------------------
+# drain-during-handoff regression
+
+
+class TestDrainDuringHandoff:
+    def test_pending_at_drain_is_explicit_unserved(self, resil_models):
+        """The regression: a request exported from prefill but not yet
+        re-landed on decode (a pending retry) at drain time must be an
+        EXPLICIT unserved, never silently lost."""
+        from flexflow_tpu.apps.serve import _DrainAfter
+        from flexflow_tpu.serve.router import ServeRouter
+
+        prefill, decode = _fresh_engines(resil_models)
+        router = ServeRouter(prefill, decode, log=lambda *a: None)
+        stranded = _req(77)
+        router._pseq += 1
+        router._pending.append((0.0, router._pseq, "dispatch",
+                                stranded, 0))
+        summary = router.run([], drain=_DrainAfter(0))
+        assert summary["drained"]
+        assert summary["unserved"] == 1
+        assert summary["completed"] == 0
+        assert summary["requests"] == 1
+        assert stranded.reply is None
+
+    def test_drain_lands_on_live_pending_retry(self, resil_models,
+                                               tmp_path):
+        """End to end: drop the first handoff onto a LONG backoff, then
+        drain the instant the retry is pending — the dropped request
+        (and the queued rest) come back explicitly unserved, in-flight
+        work finishes, and nothing is silently lost."""
+        from flexflow_tpu import obs
+
+        class _DrainWhenPending(dict):
+            router = None
+
+            def get(self, key, default=None):
+                if key == "requested":
+                    return bool(self.router._pending)
+                return default
+
+        from flexflow_tpu.serve.router import ServeRouter
+
+        olog = obs.RunLog(str(tmp_path / "d.jsonl"), surface="serve")
+        prefill, decode = _fresh_engines(resil_models)
+        router = ServeRouter(
+            prefill, decode, log=lambda *a: None, olog=olog,
+            retry_policy=RetryPolicy(attempts=50, base_delay=10.0,
+                                     max_delay=10.0, jitter=0.0))
+        drain = _DrainWhenPending()
+        drain.router = router
+        inj = faultinject.FaultInjector("handoff_drop@1", olog=olog)
+        restore = faultinject.install_scoped(inj)
+        try:
+            reqs = _session_load()
+            summary = router.run(reqs, drain=drain)
+        finally:
+            restore()
+        olog.close()
+        assert inj.fired("handoff_drop") == 1
+        assert summary["drained"]
+        assert summary["unserved"] >= 1
+        assert summary["failed"] == 0
+        assert summary["completed"] + summary["unserved"] == 12
+        assert summary["requests"] == 12
+        # no serve_fault: the drop was still inside its retry budget
+        events = list(obs.read_run(olog.path))
+        assert not [e for e in events
+                    if e.get("kind") == "serve_fault"]
+
+
+# ---------------------------------------------------------------------------
+# fleet: degraded-capacity bid
+
+
+class TestFleetDegraded:
+    def _serve_job(self, olog=None):
+        from flexflow_tpu.fleet.job import Job, JobSpec
+
+        spec = JobSpec(job_id="s", kind="serve", build=None,
+                       config=None, min_devices=2, max_devices=4,
+                       queue_hi=4, sim_steps=2)
+        return Job(spec, olog=olog, log=lambda *a: None)
+
+    def test_degraded_serve_job_bids_max(self, tmp_path):
+        from flexflow_tpu import obs
+
+        olog = obs.RunLog(str(tmp_path / "f.jsonl"), surface="fleet")
+        job = self._serve_job(olog)
+        # calm queue (sim backlog 2 < queue_hi 4): yields to min
+        assert job.demand(8) == 2
+        job.mark_degraded(1, reason="replica_crash")
+        # lost capacity: same load on less hardware -> emergency max
+        assert job.degraded == 1
+        assert job.demand(8) == 4
+        olog.close()
+        downs = [e for e in obs.read_run(olog.path)
+                 if e.get("kind") == "replica_down"]
+        assert len(downs) == 1
+        assert downs[0]["job"] == "s"
+        assert downs[0]["replicas_lost"] == 1
+        assert downs[0]["reason"] == "replica_crash"
+        # explicit clear ends the emergency bid
+        job.mark_degraded(0)
+        assert job.degraded == 0 and job.demand(8) == 2
+
+    def test_degraded_shifts_coordinator_demand_key(self):
+        """The re-price trigger: mark_degraded changes the _demands()
+        tuple the coordinator compares between rounds."""
+        from flexflow_tpu.fleet import FleetCoordinator
+        from flexflow_tpu.fleet.arbiter import Arbiter
+        from flexflow_tpu.machine import MachineModel
+
+        coord = FleetCoordinator(
+            MachineModel.virtual(8), pricer=Arbiter.proxy_pricer,
+            quantum=4, log=lambda *a: None)
+        job = self._serve_job()
+        coord.jobs.append(job)
+        before = coord._demands()
+        job.mark_degraded(2)
+        after = coord._demands()
+        assert before != after
+        assert dict(after)["s"] == 4
+
+    def test_non_serve_job_rejects_degraded(self):
+        from flexflow_tpu.fleet.job import (Job, JobSpec,
+                                            JobStateError)
+
+        spec = JobSpec(job_id="t", kind="train", build=None,
+                       config=None, min_devices=1, max_devices=4,
+                       sim_steps=2)
+        job = Job(spec, log=lambda *a: None)
+        with pytest.raises(JobStateError, match="serve"):
+            job.mark_degraded(1)
+
+
+# ---------------------------------------------------------------------------
+# obs surfaces: report, summarize, trace, metrics
+
+
+def _chaos_records():
+    """A hand-built chaos obs stream: one retried drop, one rebuilt
+    corruption, one exhausted request, one shed arrival, one crash."""
+    return [
+        {"kind": "serve_request", "rid": 1, "arrival_v": 0.0,
+         "admit_v": 0.01, "first_token_v": 0.02, "done_v": 0.06,
+         "latency_s": 0.06, "ttft_s": 0.02, "tpot_s": 0.01,
+         "prompt_len": 4, "new_tokens": 4, "pool": "decode"},
+        {"kind": "serve_retry", "rid": 1, "attempt": 1,
+         "delay_s": 0.025, "reason": "handoff_drop", "vnow": 0.02},
+        {"kind": "kv_rebuild", "rid": 1, "session": 5, "tokens": 7,
+         "to_replica": 0, "vnow": 0.03},
+        {"kind": "serve_fault", "rid": 2, "session": None,
+         "reason": "handoff_drop", "attempts": 4, "vnow": 0.05},
+        {"kind": "serve_shed", "rid": 3, "session": None,
+         "vnow": 0.04, "burn_rate": 3.5, "priority": 0},
+        {"kind": "replica_down", "pool": "decode", "replica": 1,
+         "vnow": 0.02, "in_flight": 2, "queued": 1,
+         "restart_s": 0.05},
+        {"kind": "router_summary", "requests": 4, "completed": 1,
+         "unserved": 0, "dropped": 0, "shed": 1, "failed": 1,
+         "qps": 16.7, "p50_s": 0.06, "p99_s": 0.06,
+         "ttft_p50_s": 0.02, "ttft_p99_s": 0.02, "tpot_p50_s": 0.01,
+         "tpot_p99_s": 0.01, "steps": 6, "resizes": 0,
+         "virtual_s": 0.06, "drained": False, "devices": 8,
+         "handoffs": 2, "affinity_hits": 0, "kv_refetches": 0,
+         "retries": 1, "kv_rebuilds": 1, "replica_down": 1,
+         "hedges": 0, "hedge_wins": 0, "replicas_live": 2,
+         "recovery": {"handoff_drop": {"n": 1, "p50_s": 0.04,
+                                       "p99_s": 0.04}},
+         "pools": {"prefill": {"replicas": 2, "devices": 4,
+                               "steps": 3, "completed": 0},
+                   "decode": {"replicas": 2, "devices": 4,
+                              "steps": 3, "completed": 1}}},
+    ]
+
+
+class TestChaosObs:
+    def test_report_renders_resilience(self, tmp_path):
+        from flexflow_tpu import obs
+        from flexflow_tpu.apps.report import serve_main
+
+        olog = obs.RunLog(str(tmp_path / "r.jsonl"), surface="serve")
+        for rec in _chaos_records():
+            olog.event(rec["kind"],
+                       **{k: v for k, v in rec.items() if k != "kind"})
+        olog.close()
+        rendered = []
+        rc = serve_main([olog.path], log=lambda m: rendered.append(m))
+        text = "\n".join(rendered)
+        assert rc == 0
+        assert "replica_down[decode[1]]" in text
+        assert "2 in-flight re-prefill, 1 queued retransmit" in text
+        assert "resilience: 1 serve_retry (handoff_drop x1), " \
+               "1 kv_rebuild" in text
+        assert "1 serve_fault (retry budget exhausted)" in text
+        assert "shed: 1 arrival(s) refused by the SLO-burn" in text
+        assert "explicit serve_shed, not drops" in text
+        assert "1 replica(s) down" in text and "1 failed" in text
+
+    def test_summarize_resilience_block(self, tmp_path):
+        from flexflow_tpu import obs
+        from flexflow_tpu.obs.report import summarize
+
+        olog = obs.RunLog(str(tmp_path / "s.jsonl"), surface="serve")
+        for rec in _chaos_records():
+            olog.event(rec["kind"],
+                       **{k: v for k, v in rec.items() if k != "kind"})
+        olog.close()
+        sv = summarize(list(obs.read_run(olog.path)))["serve"]
+        assert sv["resilience"] == {
+            "retries": 1, "faults": 1, "kv_rebuilds": 1, "sheds": 1,
+            "replica_downs": 1}
+        assert sv["router"]["replica_down"] == 1
+        assert sv["router"]["replicas_live"] == 2
+        assert sv["router"]["recovery"]["handoff_drop"]["n"] == 1
+
+    def test_trace_fault_marks(self):
+        from flexflow_tpu.obs.trace import (chrome_trace,
+                                            serve_trace_events,
+                                            validate_trace)
+
+        evs = serve_trace_events(_chaos_records())
+        assert validate_trace(chrome_trace(evs)) == []
+        faults = [e for e in evs if e.get("cat") == "fault"]
+        # instant marks only — never "compute" spans that would trip
+        # the overlap check
+        assert faults and all(e["ph"] == "i" for e in faults)
+        names = [e["name"] for e in faults]
+        for kind in ("serve_retry", "kv_rebuild", "serve_fault",
+                     "serve_shed"):
+            assert kind in names
+        assert "replica_down decode[1]" in names
+        down = next(e for e in faults
+                    if e["name"].startswith("replica_down"))
+        assert down["tid"] == 9 and down["s"] == "p"
+        assert down["args"]["in_flight"] == 2
+        # the shed rid has no serve_request record, yet gets a lane
+        shed = next(e for e in faults if e["name"] == "serve_shed")
+        assert shed["tid"] >= 10
+        assert shed["args"]["burn_rate"] == 3.5
+
+    def test_metrics_gauges(self, tmp_path):
+        from flexflow_tpu.obs.metrics import (MetricsExporter,
+                                              read_textfile)
+
+        path = str(tmp_path / "m.prom")
+        ex = MetricsExporter(path)
+        ex.update(serve_retries_total=3, serve_shed_total=2,
+                  replicas_live=1)
+        ex.write()
+        vals = read_textfile(path)
+        assert vals["serve_retries_total"] == 3
+        assert vals["serve_shed_total"] == 2
+        assert vals["replicas_live"] == 1
+        text = open(path).read()
+        assert "# TYPE ff_serve_retries_total counter" in text
+        assert "# TYPE ff_serve_shed_total counter" in text
+        assert "# TYPE ff_replicas_live gauge" in text
+
+
+# ---------------------------------------------------------------------------
+# the committed SERVE_r03 bounded-degradation artifact
+
+
+class TestServeR03Artifact:
+    def test_bounded_degradation_vs_r02(self):
+        r03_path = os.path.join(REPO_ROOT, "SERVE_r03.json")
+        r02_path = os.path.join(REPO_ROOT, "SERVE_r02.json")
+        if not (os.path.exists(r03_path) and os.path.exists(r02_path)):
+            pytest.skip("committed artifacts not present")
+        with open(r03_path) as f:
+            r03 = json.load(f)
+        with open(r02_path) as f:
+            r02 = json.load(f)
+        assert r03["schema"] == "serve_bench_v1" and r03["disagg"]
+        for kind in ("replica_crash", "handoff_drop", "kv_corrupt"):
+            assert kind in r03["chaos"]
+        # identical seeded traffic to the fault-free baseline
+        for k in ("seed", "pattern", "requests_per_point", "rate_qps",
+                  "slots_per_device", "slo"):
+            assert r03[k] == r02[k], f"traffic spec drift on {k}"
+        vs = r03["vs_r02"]
+        assert vs["baseline"] == "SERVE_r02.json"
+        for dev, pt in vs["points"].items():
+            # zero silent losses at every sweep point
+            assert pt["no_silent_loss"] is True
+            assert pt["accounted"] == pt["offered"] == 60
+            assert pt["completed"] + pt["unserved"] + pt["shed"] \
+                + pt["failed"] == pt["accounted"]
+            # the injected chaos actually happened...
+            assert pt["replica_downs"] == 1
+            assert pt["kv_rebuilds"] >= 1
+            assert pt["retries"] >= 1
+            # ...and degradation stayed bounded
+            assert pt["goodput_ratio"] >= 0.9
+            assert pt["p99_ratio"] <= 4.0
+        for p in r03["sweep"]:
+            assert math.isfinite(p["p99_s"])
+            # the crashed replica revived by run end
+            assert p["replicas_live"] >= 1
+            assert p["faults_fired"] >= 1
+            assert "recovery" in p
